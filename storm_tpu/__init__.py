@@ -1,0 +1,44 @@
+"""storm_tpu — a TPU-native streaming inference framework.
+
+A ground-up rebuild of the capability set of
+HyoJong-Moon/Distributed-Inference-System-based-Storm (Apache Storm + Kafka +
+TensorFlow-Java), redesigned TPU-first:
+
+- the streaming dataflow runtime (spout/bolt/grouping/ack, at-least-once)
+  is an asyncio runtime instead of Storm workers (reference layer 1,
+  SURVEY.md §1);
+- ingress/egress keep the exact ``{"instances": ...}`` / ``{"predictions": ...}``
+  JSON wire contract of the reference (reference README.md:22-34,
+  data/InstObj.java:8, data/PredObj.java:9);
+- the inference operator (reference InferenceBolt.java) becomes a
+  deadline-based micro-batcher feeding JAX/XLA on TPU via ``jit``/``pjit``
+  over a ``jax.sharding.Mesh`` — the reference's per-operator
+  ``parallelismHint`` (MainTopology.java:26-28) maps to data-parallel
+  shards on the ICI mesh;
+- attention-bearing models (ViT) run a Pallas flash-attention kernel.
+
+Public surface::
+
+    from storm_tpu import TopologyBuilder, LocalCluster, Config
+    from storm_tpu.infer import InferenceBolt
+    from storm_tpu.connectors import BrokerSpout, BrokerSink, MemoryBroker
+"""
+
+__version__ = "0.1.0"
+
+from storm_tpu.config import Config, TopologyConfig, ModelConfig, BatchConfig
+from storm_tpu.runtime.topology import TopologyBuilder
+from storm_tpu.runtime.cluster import LocalCluster
+from storm_tpu.runtime.tuples import Tuple, Values
+
+__all__ = [
+    "Config",
+    "TopologyConfig",
+    "ModelConfig",
+    "BatchConfig",
+    "TopologyBuilder",
+    "LocalCluster",
+    "Tuple",
+    "Values",
+    "__version__",
+]

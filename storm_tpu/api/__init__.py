@@ -1,0 +1,15 @@
+from storm_tpu.api.schema import (
+    Instances,
+    Predictions,
+    SchemaError,
+    decode_instances,
+    encode_predictions,
+)
+
+__all__ = [
+    "Instances",
+    "Predictions",
+    "SchemaError",
+    "decode_instances",
+    "encode_predictions",
+]

@@ -1,0 +1,206 @@
+"""Typed configuration for the whole framework.
+
+Replaces the reference's four config mechanisms (SURVEY.md §5.6): compile-time
+parallelism constants (MainTopology.java:25-28), three positional CLI args
+(:36-38), edit-the-source cluster endpoints (:33-34), and hard-coded model
+metadata (InferenceBolt.java:83-86) — with one dataclass tree loadable from
+TOML/JSON and overridable from the CLI. Nothing requires a rebuild.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+
+@dataclass
+class BatchConfig:
+    """Micro-batching policy for the inference operator.
+
+    The reference runs batch=1 per ``session.run`` (InferenceBolt.java:80-86);
+    here batches are formed up to ``max_batch`` or until ``max_wait_ms``
+    elapses, and padded up to the nearest of ``buckets`` so XLA compiles a
+    small, fixed set of shapes.
+    """
+
+    max_batch: int = 256
+    max_wait_ms: float = 5.0
+    # Padding buckets (ascending). Batches are padded to the smallest bucket
+    # >= their size; the final entry must equal max_batch.
+    buckets: tuple = (8, 32, 128, 256)
+
+    def __post_init__(self) -> None:
+        self.buckets = tuple(sorted(set(int(b) for b in self.buckets)))
+        if not self.buckets:
+            self.buckets = (self.max_batch,)
+        if self.buckets[-1] != self.max_batch:
+            self.buckets = tuple(b for b in self.buckets if b < self.max_batch) + (
+                self.max_batch,
+            )
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+
+@dataclass
+class ModelConfig:
+    """Which model an inference operator runs, and how.
+
+    Replaces the hard-coded SavedModel blob + tensor names
+    (InferenceBolt.java:57, :83-84) with a registry name and an optional
+    checkpoint path (artifact store instead of ship-model-inside-the-jar,
+    InferenceBolt.java:49-51).
+    """
+
+    name: str = "lenet5"  # key into storm_tpu.models.registry
+    checkpoint: Optional[str] = None  # orbax checkpoint dir; None = random init
+    dtype: str = "bfloat16"  # compute dtype on TPU
+    num_classes: int = 10
+    input_shape: tuple = (28, 28, 1)  # per-instance HWC
+    seed: int = 0
+
+
+@dataclass
+class ShardingConfig:
+    """How the operator's work maps onto the TPU mesh.
+
+    ``data_parallel`` is the TPU-native meaning of the reference's
+    ``INFERENCE_BOLT_PARAL = 4`` (MainTopology.java:27): shards of the batch
+    axis over the ICI mesh rather than replicated JVM executors.
+    """
+
+    data_parallel: int = 1  # dp axis size (0 = use all available devices)
+    tensor_parallel: int = 1  # tp axis size (param sharding)
+    axis_names: tuple = ("data", "model")
+
+
+@dataclass
+class OffsetsConfig:
+    """Stream-position policy for the ingest spout.
+
+    ``policy='latest'`` reproduces the reference's freshness-over-completeness
+    semantics (start at latest, ignore stored offsets, drop backlog —
+    MainTopology.java:101-103). ``policy='resume'`` commits offsets and
+    resumes, which the reference deliberately lacked (SURVEY.md §5.4).
+    """
+
+    policy: str = "latest"  # 'latest' | 'earliest' | 'resume'
+    max_behind: Optional[int] = 0  # drop records more than N offsets behind; None = unbounded
+    group_id: Optional[str] = None  # None = fresh random group per run (reference behavior)
+
+    def __post_init__(self) -> None:
+        if self.policy not in ("latest", "earliest", "resume"):
+            raise ValueError(f"unknown offsets policy {self.policy!r}")
+
+
+@dataclass
+class SinkConfig:
+    """Producer-side delivery policy: the three ack modes of the reference's
+    KafkaBolt (async-with-callback / sync / fire-and-forget,
+    KafkaBolt.java:129-155)."""
+
+    mode: str = "async"  # 'async' | 'sync' | 'fire_and_forget'
+    acks: int = 1  # mirrors acks=1 (MainTopology.java:113)
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("async", "sync", "fire_and_forget"):
+            raise ValueError(f"unknown sink mode {self.mode!r}")
+
+
+@dataclass
+class TopologyConfig:
+    """Topology-level knobs: the reference's parallelism constants
+    (MainTopology.java:25-28) plus runtime policies, all runtime-settable."""
+
+    name: str = "inference-topology"
+    spout_parallelism: int = 2  # KAFKA_SPOUT_PARAL
+    inference_parallelism: int = 4  # INFERENCE_BOLT_PARAL
+    sink_parallelism: int = 2  # KAFKA_BOLT_PARAL
+    max_spout_pending: int = 2048  # in-flight roots per spout instance
+    message_timeout_s: float = 30.0  # at-least-once replay timeout
+    inbox_capacity: int = 4096  # bounded executor queues (backpressure)
+    tick_interval_s: float = 0.0  # 0 = no tick tuples
+
+
+@dataclass
+class BrokerConfig:
+    """Where records come from / go to. Replaces the empty-string
+    ``zkHosts``/``bootstrap`` edit-the-source fields (MainTopology.java:33-34)."""
+
+    kind: str = "memory"  # 'memory' | 'kafka'
+    bootstrap: str = ""  # host:port list for kind='kafka'
+    input_topic: str = "input"
+    output_topic: str = "output"
+    dead_letter_topic: str = "dead-letter"
+    partitions: int = 4  # partitions for memory broker topics
+
+
+@dataclass
+class Config:
+    topology: TopologyConfig = field(default_factory=TopologyConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    batch: BatchConfig = field(default_factory=BatchConfig)
+    sharding: ShardingConfig = field(default_factory=ShardingConfig)
+    offsets: OffsetsConfig = field(default_factory=OffsetsConfig)
+    sink: SinkConfig = field(default_factory=SinkConfig)
+    broker: BrokerConfig = field(default_factory=BrokerConfig)
+
+    # ---- loading / overriding -------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Config":
+        cfg = cls()
+        cfg.apply_dict(d)
+        return cfg
+
+    def apply_dict(self, d: dict) -> None:
+        for section, values in d.items():
+            if not hasattr(self, section):
+                raise KeyError(f"unknown config section {section!r}")
+            sub = getattr(self, section)
+            if not isinstance(values, dict):
+                raise TypeError(f"config section {section!r} must be a table/dict")
+            for k, v in values.items():
+                if not hasattr(sub, k):
+                    raise KeyError(f"unknown config key {section}.{k}")
+                cur = getattr(sub, k)
+                if isinstance(cur, tuple) and isinstance(v, list):
+                    v = tuple(v)
+                setattr(sub, k, v)
+            if hasattr(sub, "__post_init__"):
+                sub.__post_init__()
+
+    @classmethod
+    def load(cls, path: str | Path) -> "Config":
+        """Load TOML or JSON config file."""
+        path = Path(path)
+        text = path.read_text()
+        if path.suffix == ".json":
+            return cls.from_dict(json.loads(text))
+        import tomllib
+
+        return cls.from_dict(tomllib.loads(text))
+
+    def apply_overrides(self, overrides: list) -> None:
+        """Apply ``section.key=value`` CLI overrides."""
+        patch: dict = {}
+        for item in overrides:
+            key, _, raw = item.partition("=")
+            if not _:
+                raise ValueError(f"override must be section.key=value: {item!r}")
+            section, _, k = key.partition(".")
+            try:
+                val = json.loads(raw)
+            except json.JSONDecodeError:
+                val = raw
+            patch.setdefault(section, {})[k] = val
+        self.apply_dict(patch)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)

@@ -1,0 +1,11 @@
+from storm_tpu.connectors.memory import MemoryBroker, Record
+from storm_tpu.connectors.spout import BrokerSpout
+from storm_tpu.connectors.sink import BrokerSink, DefaultTopicSelector
+
+__all__ = [
+    "MemoryBroker",
+    "Record",
+    "BrokerSpout",
+    "BrokerSink",
+    "DefaultTopicSelector",
+]

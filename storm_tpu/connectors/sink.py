@@ -1,0 +1,189 @@
+"""Egress sink: the KafkaBolt equivalent (reference KafkaBolt.java, a
+vendored copy of Storm's producer bolt — SURVEY.md §2.1 KafkaBolt row).
+
+Reproduces the full behavior matrix of the reference's ``process()``
+(KafkaBolt.java:116-166):
+
+- **async** (default, ``async=true, fireAndForget=false`` :50-54): send with
+  a completion callback; ack the tuple on delivery success, report+fail on
+  error — the only place in the system where delivery failure propagates
+  backward into a replay;
+- **sync** (:145-152): await the send result, then ack/fail;
+- **fire_and_forget** (:153-155): send and ack immediately;
+- a ``None`` topic from the selector warns and acks without sending
+  (:156-159);
+- any mapping/serialization error reports + fails the tuple (:160-162);
+- ``cleanup()`` closes the producer (:175-177).
+
+The tuple->record mapping mirrors ``FieldNameBasedTupleToKafkaMapper``
+(fields ``key``/``message``, KafkaBolt.java:87-92). ``make_producer`` is the
+explicit test seam the reference inherited (``mkProducer`` "intended to be
+overridden for tests", KafkaBolt.java:109-113).
+
+Also records the end-to-end (root ingress -> delivered) latency histogram —
+the north-star Kafka->Kafka metric (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Callable, Optional
+
+from storm_tpu.config import SinkConfig
+from storm_tpu.connectors.memory import MemoryBroker
+from storm_tpu.runtime.base import Bolt, OutputCollector, TopologyContext
+from storm_tpu.runtime.tuples import Tuple
+
+log = logging.getLogger("storm_tpu.sink")
+
+
+class DefaultTopicSelector:
+    """Constant topic (reference DefaultTopicSelector, MainTopology.java:56)."""
+
+    def __init__(self, topic: Optional[str]) -> None:
+        self.topic = topic
+
+    def __call__(self, t: Tuple) -> Optional[str]:
+        return self.topic
+
+
+class Producer:
+    """Minimal producer interface; raise from ``send`` to signal delivery
+    failure. Implementations must be safe to call from the event loop."""
+
+    async def send(self, topic: str, value: bytes, key: Optional[bytes]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryProducer(Producer):
+    def __init__(self, broker: MemoryBroker) -> None:
+        self.broker = broker
+
+    async def send(self, topic: str, value: bytes, key: Optional[bytes]) -> None:
+        self.broker.produce(topic, value, key)
+
+
+class BrokerSink(Bolt):
+    def __init__(
+        self,
+        broker: Optional[MemoryBroker] = None,
+        topic: Optional[str] = None,
+        sink: Optional[SinkConfig] = None,
+        topic_selector: Optional[Callable[[Tuple], Optional[str]]] = None,
+    ) -> None:
+        self.broker = broker
+        self.sink_cfg = sink or SinkConfig()
+        self.topic_selector = topic_selector or DefaultTopicSelector(topic)
+        self._inflight: set = set()
+
+    def clone(self) -> "BrokerSink":
+        """Per-task instance sharing the broker handle. Works for subclasses
+        that override ``make_producer`` (the test seam)."""
+        c = type(self).__new__(type(self))
+        c.broker = self.broker
+        c.sink_cfg = self.sink_cfg
+        c.topic_selector = self.topic_selector
+        c._inflight = set()
+        return c
+
+    # Test seam, mirroring the reference's protected mkProducer
+    # (KafkaBolt.java:109-113): override to inject a failing/mock producer.
+    def make_producer(self) -> Producer:
+        if self.broker is None:
+            raise ValueError("BrokerSink needs a broker or an overridden make_producer")
+        return MemoryProducer(self.broker)
+
+    def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
+        super().prepare(context, collector)
+        self.producer = self.make_producer()
+        self._latency = context.metrics.histogram(
+            context.component_id, "e2e_latency_ms"
+        )
+        self._delivered = context.metrics.counter(context.component_id, "delivered")
+
+    # ---- mapping (FieldNameBasedTupleToKafkaMapper semantics) ----------------
+
+    def _map(self, t: Tuple) -> tuple:
+        value = t.get("message")
+        if isinstance(value, str):
+            value = value.encode("utf-8")
+        elif not isinstance(value, (bytes, bytearray)):
+            value = str(value).encode("utf-8")
+        key = None
+        if "key" in t.fields:
+            key = t.get("key")
+            if isinstance(key, str):
+                key = key.encode("utf-8")
+        return key, value
+
+    # ---- the three delivery modes --------------------------------------------
+
+    async def execute(self, t: Tuple) -> None:
+        try:
+            key, value = self._map(t)
+            topic = self.topic_selector(t)
+        except Exception as e:
+            # Mapping failure: report + fail (KafkaBolt.java:160-162).
+            self.collector.report_error(e)
+            self.collector.fail(t)
+            return
+
+        if topic is None:
+            # Null topic: warn + ack without sending (KafkaBolt.java:156-159).
+            log.warning("topic selector returned None; acking without send")
+            self.collector.ack(t)
+            return
+
+        mode = self.sink_cfg.mode
+        if mode == "fire_and_forget":
+            task = asyncio.get_running_loop().create_task(
+                self._send_quiet(topic, value, key)
+            )
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+            self._ack_delivered(t)
+        elif mode == "sync":
+            try:
+                await self.producer.send(topic, value, key)
+            except Exception as e:
+                self.collector.report_error(e)
+                self.collector.fail(t)
+                return
+            self._ack_delivered(t)
+        else:  # async with callback
+            task = asyncio.get_running_loop().create_task(
+                self._send_tracked(t, topic, value, key)
+            )
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+
+    async def _send_quiet(self, topic: str, value: bytes, key: Optional[bytes]) -> None:
+        try:
+            await self.producer.send(topic, value, key)
+        except Exception as e:  # fire-and-forget: drop errors
+            log.debug("fire-and-forget send failed: %s", e)
+
+    async def _send_tracked(
+        self, t: Tuple, topic: str, value: bytes, key: Optional[bytes]
+    ) -> None:
+        try:
+            await self.producer.send(topic, value, key)
+        except Exception as e:
+            self.collector.report_error(e)
+            self.collector.fail(t)
+            return
+        self._ack_delivered(t)
+
+    def _ack_delivered(self, t: Tuple) -> None:
+        self._delivered.inc()
+        if t.root_ts:
+            self._latency.observe((time.perf_counter() - t.root_ts) * 1e3)
+        self.collector.ack(t)
+
+    def cleanup(self) -> None:
+        self.producer.close()

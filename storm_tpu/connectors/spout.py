@@ -1,0 +1,152 @@
+"""Ingest spout: the KafkaSpout equivalent.
+
+Reproduces the reference's consumer semantics as *policy*, not hard-coding
+(MainTopology.java:95-106, SURVEY.md §2.1 KafkaSpout row):
+
+- ``policy='latest'`` + ``max_behind=0``: start at the log end, ignore
+  committed offsets, drop any backlog — the reference's deliberate
+  freshness-over-completeness configuration (``ignoreZkOffsets=true``,
+  ``startOffsetTime=LatestTime``, ``maxOffsetBehind=0``,
+  MainTopology.java:101-103);
+- ``policy='resume'``: commit offsets on ack and resume from the committed
+  position — the recovery mode the reference lacked (SURVEY.md §5.4);
+- ``policy='earliest'``: replay the full log.
+
+At-least-once: each record is emitted with ``msg_id=(partition, offset)``;
+failed/timed-out trees are re-emitted from a replay queue before new fetches
+(unless the freshness policy says they are already too stale to matter).
+
+Partitions are assigned to spout tasks round-robin by task index, like
+Kafka's consumer-group assignment across the reference's 2 spout executors.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+import uuid
+from typing import Any, Deque, Dict, Optional, Tuple
+
+from storm_tpu.config import OffsetsConfig
+from storm_tpu.connectors.memory import MemoryBroker, Record
+from storm_tpu.runtime.base import Spout, TopologyContext, OutputCollector
+from storm_tpu.runtime.tuples import Values
+
+
+class BrokerSpout(Spout):
+    def __init__(
+        self,
+        broker: MemoryBroker,
+        topic: str,
+        offsets: Optional[OffsetsConfig] = None,
+        fetch_size: int = 256,
+    ) -> None:
+        self.broker = broker
+        self.topic = topic
+        self.offsets_cfg = offsets or OffsetsConfig()
+        self.fetch_size = fetch_size
+
+    def clone(self) -> "BrokerSpout":
+        """Per-task instance sharing the broker handle (the broker is a
+        shared external resource, not per-task state)."""
+        return type(self)(self.broker, self.topic, self.offsets_cfg, self.fetch_size)
+
+    def open(self, context: TopologyContext, collector: OutputCollector) -> None:
+        super().open(context, collector)
+        cfg = self.offsets_cfg
+        # Random group per run mirrors the reference's UUID consumer id
+        # (MainTopology.java:98-99) unless the user pins one for resume.
+        self.group = cfg.group_id or f"storm-tpu-{uuid.uuid4()}"
+        n_parts = self.broker.partitions_for(self.topic)
+        self.my_partitions = [
+            p for p in range(n_parts) if p % context.parallelism == context.task_index
+        ]
+        self.positions: Dict[int, int] = {}
+        self.pending: Dict[Tuple[int, int], Record] = {}
+        self.replay: Deque[Record] = collections.deque()
+        self.dropped = 0
+        self._rr = 0
+        for p in self.my_partitions:
+            if cfg.policy == "latest":
+                pos = self.broker.latest_offset(self.topic, p)
+            elif cfg.policy == "earliest":
+                pos = self.broker.earliest_offset(self.topic, p)
+            else:  # resume
+                committed = self.broker.committed(self.group, self.topic, p)
+                pos = committed if committed is not None else self.broker.earliest_offset(self.topic, p)
+                # Startup freshness clamp: a resume position more than
+                # max_behind behind the log end jumps forward, dropping the
+                # backlog (Storm's maxOffsetBehind startup behavior that the
+                # reference sets to 0, MainTopology.java:103).
+                if cfg.max_behind is not None:
+                    latest = self.broker.latest_offset(self.topic, p)
+                    if latest - pos > cfg.max_behind:
+                        self.dropped += latest - cfg.max_behind - pos
+                        pos = latest - cfg.max_behind
+            self.positions[p] = pos
+
+    # ---- Spout API -----------------------------------------------------------
+
+    async def next_tuple(self) -> bool:
+        # Replays first: failed trees take priority over new data.
+        if self.replay:
+            rec = self.replay.popleft()
+            await self._emit(rec)
+            return True
+        if not self.my_partitions:
+            return False
+        # Round-robin over owned partitions.
+        for _ in range(len(self.my_partitions)):
+            p = self.my_partitions[self._rr % len(self.my_partitions)]
+            self._rr += 1
+            pos = self.positions[p]
+            records = self.broker.fetch(self.topic, p, pos, self.fetch_size)
+            if not records:
+                continue
+            emitted = 0
+            for rec in records:
+                await self._emit(rec)
+                emitted += 1
+            self.positions[p] = records[-1].offset + 1
+            if emitted:
+                return True
+        return False
+
+    async def _emit(self, rec: Record) -> None:
+        msg_id = (rec.partition, rec.offset)
+        self.pending[msg_id] = rec
+        await self.collector.emit(
+            Values([rec.value.decode("utf-8", "replace")]),
+            msg_id=msg_id,
+            root_ts=time.perf_counter(),
+        )
+
+    def ack(self, msg_id: Any) -> None:
+        self.pending.pop(msg_id, None)
+        if self.offsets_cfg.policy == "resume":
+            p, off = msg_id
+            # Commit the contiguous low-water mark for this partition —
+            # including failed records awaiting replay, or a restart would
+            # skip them and break the resume policy's at-least-once promise.
+            open_offs = [o for (pp, o) in self.pending if pp == p]
+            open_offs += [r.offset for r in self.replay if r.partition == p]
+            low = min(open_offs) if open_offs else off + 1
+            prev = self.broker.committed(self.group, self.topic, p)
+            if prev is None or low > prev:
+                self.broker.commit(self.group, self.topic, p, low)
+
+    def fail(self, msg_id: Any) -> None:
+        rec = self.pending.pop(msg_id, None)
+        if rec is None:
+            return
+        max_behind = self.offsets_cfg.max_behind
+        if max_behind is not None:
+            latest = self.broker.latest_offset(self.topic, rec.partition)
+            if latest - rec.offset > max_behind:
+                # Too stale to replay under the freshness policy.
+                self.dropped += 1
+                self.context.metrics.counter(
+                    self.context.component_id, "dropped_stale"
+                ).inc()
+                return
+        self.replay.append(rec)

@@ -1,0 +1,89 @@
+"""Native (C++) acceleration layer, loaded via ctypes with Python fallback.
+
+The reference's hot-path marshalling was Jackson JSON parse + JNI float-array
+copies (InferenceBolt.java:76-86). Here the equivalent is a C++ shared library
+(``libstormtpu.so``) that parses ``{"instances": ...}`` payloads straight into
+a contiguous float32 buffer handed to NumPy zero-copy. If the library has not
+been built (``make -C storm_tpu/native``), every entry point degrades to a
+pure-Python implementation — functionality is identical, only slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_LIB_PATH = Path(__file__).parent / "libstormtpu.so"
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+_MAX_RANK = 8
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("STORM_TPU_NO_NATIVE"):
+        return None
+    if not _LIB_PATH.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.stpu_parse_instances.restype = ctypes.c_void_p
+        lib.stpu_parse_instances.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int64),  # out shape[_MAX_RANK]
+            ctypes.POINTER(ctypes.c_int32),  # out rank
+            ctypes.POINTER(ctypes.c_char_p),  # out error message
+        ]
+        lib.stpu_free.restype = None
+        lib.stpu_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def parse_instances_native(payload: str | bytes) -> Optional[np.ndarray]:
+    """Parse an ``{"instances": ...}`` JSON payload with the C++ parser.
+
+    Returns ``None`` when the native library is unavailable (caller falls back
+    to the Python path). Raises :class:`storm_tpu.api.schema.SchemaError` on a
+    malformed payload, same as the Python path.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    from storm_tpu.api.schema import SchemaError
+
+    if isinstance(payload, str):
+        payload = payload.encode("utf-8")
+    shape = (ctypes.c_int64 * _MAX_RANK)()
+    rank = ctypes.c_int32(0)
+    err = ctypes.c_char_p(None)
+    ptr = lib.stpu_parse_instances(
+        payload, len(payload), shape, ctypes.byref(rank), ctypes.byref(err)
+    )
+    if not ptr:
+        msg = err.value.decode("utf-8", "replace") if err.value else "native parse failed"
+        raise SchemaError(msg)
+    shp = tuple(int(shape[i]) for i in range(rank.value))
+    n = 1
+    for s in shp:
+        n *= s
+    # Copy out of the C buffer into a NumPy-owned array, then free the C side.
+    buf = np.ctypeslib.as_array(ctypes.cast(ptr, ctypes.POINTER(ctypes.c_float)), (n,))
+    out = np.array(buf, dtype=np.float32).reshape(shp)
+    lib.stpu_free(ptr)
+    return out

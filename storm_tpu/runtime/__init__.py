@@ -1,0 +1,17 @@
+from storm_tpu.runtime.tuples import Tuple, TickTuple, Values
+from storm_tpu.runtime.topology import TopologyBuilder, Topology
+from storm_tpu.runtime.base import Spout, Bolt, OutputCollector, TopologyContext
+from storm_tpu.runtime.cluster import LocalCluster
+
+__all__ = [
+    "Tuple",
+    "TickTuple",
+    "Values",
+    "TopologyBuilder",
+    "Topology",
+    "Spout",
+    "Bolt",
+    "OutputCollector",
+    "TopologyContext",
+    "LocalCluster",
+]

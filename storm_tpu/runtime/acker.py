@@ -1,0 +1,99 @@
+"""XOR ack ledger: at-least-once tuple tracking.
+
+Reimplements the algorithm Storm's acker executors provide to the reference
+for free (SURVEY.md §2.5 — storm-core dependency; the app participates via
+``collector.ack/fail``, InferenceBolt.java:98-99, KafkaBolt.java:134-154):
+
+- when a spout emits a root tuple with a ``msg_id``, the ledger opens an
+  entry whose value is the XOR of every live edge anchored to that root;
+- each anchored emit XORs a fresh edge id in; each ack XORs the consumed
+  edge id out; the entry reaching zero means the whole tuple tree was
+  processed, and the spout's ``ack(msg_id)`` fires;
+- an explicit ``fail`` or a timeout fires ``fail(msg_id)`` instead, which a
+  replayable spout answers by re-emitting (at-least-once).
+
+In-process we run one ledger (Storm shards across acker executors; a single
+dict is enough for one host and keeps this O(1) per event with no tasks).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Entry:
+    ack_val: int
+    msg_id: Any
+    on_done: Callable[[Any, bool, float], None]  # (msg_id, ok, root_ts)
+    born: float
+    root_ts: float
+
+
+class AckLedger:
+    def __init__(self, timeout_s: float = 30.0) -> None:
+        self.timeout_s = timeout_s
+        self._entries: Dict[int, _Entry] = {}
+        self.acked = 0
+        self.failed = 0
+        self.timed_out = 0
+
+    @property
+    def inflight(self) -> int:
+        return len(self._entries)
+
+    def init_root(
+        self,
+        root_id: int,
+        msg_id: Any,
+        on_done: Callable[[Any, bool, float], None],
+        root_ts: float,
+    ) -> None:
+        # ack_val starts at 0; the emitting collector XORs in one edge id per
+        # delivery before the first enqueue, so the entry can only reach zero
+        # again once every delivered edge has been acked.
+        self._entries[root_id] = _Entry(
+            ack_val=0,
+            msg_id=msg_id,
+            on_done=on_done,
+            born=time.monotonic(),
+            root_ts=root_ts,
+        )
+
+    def xor(self, root_id: int, edge_id: int) -> None:
+        """Fold one edge event (emit or ack of that edge) into the ledger."""
+        e = self._entries.get(root_id)
+        if e is None:  # already completed/failed/timed out — late event, drop
+            return
+        e.ack_val ^= edge_id
+        if e.ack_val == 0:
+            del self._entries[root_id]
+            self.acked += 1
+            e.on_done(e.msg_id, True, e.root_ts)
+
+    def fail_root(self, root_id: int) -> None:
+        e = self._entries.pop(root_id, None)
+        if e is None:
+            return
+        self.failed += 1
+        e.on_done(e.msg_id, False, e.root_ts)
+
+    def sweep(self) -> int:
+        """Fail entries older than the message timeout. Returns count failed.
+
+        Called periodically by the cluster (replaces Storm's
+        ``topology.message.timeout.secs`` mechanism).
+        """
+        if self.timeout_s <= 0:
+            return 0
+        now = time.monotonic()
+        stale = [rid for rid, e in self._entries.items() if now - e.born > self.timeout_s]
+        for rid in stale:
+            e = self._entries.pop(rid, None)
+            if e is not None:
+                self.timed_out += 1
+                self.failed += 1
+                e.on_done(e.msg_id, False, e.root_ts)
+        return len(stale)

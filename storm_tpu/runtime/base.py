@@ -1,0 +1,211 @@
+"""Operator API: Spout / Bolt / OutputCollector / TopologyContext.
+
+Mirrors the surface the reference programs against (``BaseRichBolt``,
+``OutputCollector``, ``TopologyContext`` — InferenceBolt.java:25,38-41,
+KafkaBolt.java:84) with two deliberate changes for the asyncio runtime:
+
+- ``execute``/``next_tuple`` are coroutines, because emitting into a bounded
+  downstream inbox is a backpressure point (Storm blocks a thread; we await);
+- uncaught exceptions in ``execute`` fail the input tuple and keep the
+  executor alive (Storm kills the worker; the reference swallowed errors and
+  acked anyway — InferenceBolt.java:92-99 — which we do NOT reproduce).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from storm_tpu.runtime.tuples import Tuple, Values, new_id
+
+
+class TopologyContext:
+    """What an operator instance knows about itself and its surroundings."""
+
+    def __init__(
+        self,
+        component_id: str,
+        task_index: int,
+        parallelism: int,
+        config: Any,
+        metrics: "Any" = None,
+    ) -> None:
+        self.component_id = component_id
+        self.task_index = task_index
+        self.parallelism = parallelism
+        self.config = config
+        self.metrics = metrics
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<TopologyContext {self.component_id}[{self.task_index}/{self.parallelism}]>"
+
+
+class OutputCollector:
+    """Routes emits, maintains ack/anchor bookkeeping.
+
+    Equivalent of Storm's ``OutputCollector``/``SpoutOutputCollector``
+    (used at InferenceBolt.java:98-99, KafkaBolt.java:134-154).
+    """
+
+    def __init__(self, runtime: "Any", component_id: str, task_index: int) -> None:
+        self._rt = runtime
+        self.component_id = component_id
+        self.task_index = task_index
+        self._out_fields: Dict[str, Sequence[str]] = {"default": ("message",)}
+
+    def set_output_fields(self, fields: Dict[str, Sequence[str]]) -> None:
+        self._out_fields = fields
+
+    # ---- emitting ------------------------------------------------------------
+
+    async def emit(
+        self,
+        values: Sequence[Any],
+        *,
+        stream: str = "default",
+        anchors: Optional[Iterable[Tuple]] = None,
+        msg_id: Any = None,
+        root_ts: Optional[float] = None,
+    ) -> int:
+        """Emit a tuple downstream. Returns the number of deliveries.
+
+        Bolt usage: ``await collector.emit(Values(out), anchors=[in_tuple])``.
+        Spout usage: ``await collector.emit(Values(x), msg_id=offset)`` —
+        a non-None ``msg_id`` opens an at-least-once ledger entry whose
+        completion/failure is reported back to the spout.
+        """
+        fields = self._out_fields.get(stream, ("message",))
+        subs = self._rt.router.subscriptions(self.component_id, stream)
+
+        roots: frozenset
+        ts = root_ts if root_ts is not None else time.perf_counter()
+        if anchors:
+            anchor_list = list(anchors)
+            roots = frozenset().union(*(a.anchors for a in anchor_list))
+            if anchor_list and root_ts is None:
+                ts = min(a.root_ts for a in anchor_list)
+        else:
+            roots = frozenset()
+
+        probe = Tuple(
+            values=list(values),
+            fields=fields,
+            source_component=self.component_id,
+            source_task=self.task_index,
+            stream=stream,
+            root_ts=ts,
+        )
+
+        deliveries: List[Any] = []  # (inbox, )
+        for grouping, group in subs:
+            for idx in grouping.choose(probe):
+                deliveries.append(group.inboxes[idx])
+
+        root_id = None
+        if msg_id is not None:
+            if not deliveries:
+                # No subscribers: complete immediately (Storm acks these).
+                self._rt.spout_done(self.component_id, self.task_index, msg_id, True, ts)
+                return 0
+            root_id = new_id()
+            self._rt.ledger.init_root(
+                root_id,
+                msg_id,
+                self._rt.spout_done_cb(self.component_id, self.task_index),
+                ts,
+            )
+            roots = frozenset((root_id,))
+
+        # XOR every new edge into the ledger BEFORE the first (possibly
+        # yielding) queue put — otherwise a fast consumer could zero the
+        # ledger while later deliveries of the same emit are still pending.
+        edges = [new_id() for _ in deliveries]
+        for edge in edges:
+            for r in roots:
+                self._rt.ledger.xor(r, edge)
+        n = 0
+        for inbox, edge in zip(deliveries, edges):
+            t = Tuple(
+                values=probe.values,
+                fields=fields,
+                source_component=self.component_id,
+                source_task=self.task_index,
+                stream=stream,
+                edge_id=edge,
+                anchors=roots,
+                root_ts=ts,
+            )
+            await inbox.put(t)
+            n += 1
+        self._rt.metrics.counter(self.component_id, "emitted").inc(n)
+        return n
+
+    # ---- acking --------------------------------------------------------------
+
+    def ack(self, t: Tuple) -> None:
+        """Mark the input tuple consumed (InferenceBolt.java:99)."""
+        for r in t.anchors:
+            self._rt.ledger.xor(r, t.edge_id)
+        self._rt.metrics.counter(self.component_id, "acked").inc()
+
+    def fail(self, t: Tuple) -> None:
+        """Fail the input tuple's roots -> spout replay (KafkaBolt.java:137)."""
+        for r in t.anchors:
+            self._rt.ledger.fail_root(r)
+        self._rt.metrics.counter(self.component_id, "failed").inc()
+
+    def report_error(self, err: BaseException) -> None:
+        self._rt.report_error(self.component_id, self.task_index, err)
+
+
+class Component:
+    """Shared declarations for spouts and bolts."""
+
+    #: stream name -> field names. Default mirrors the reference's single
+    #: ``"message"`` field (InferenceBolt.java:104, KafkaBolt mapper default).
+    def declare_output_fields(self) -> Dict[str, Sequence[str]]:
+        return {"default": ("message",)}
+
+
+class Spout(Component):
+    def open(self, context: TopologyContext, collector: OutputCollector) -> None:
+        self.context = context
+        self.collector = collector
+
+    async def next_tuple(self) -> bool:
+        """Emit zero or more tuples; return True if anything was emitted
+        (False lets the executor back off briefly)."""
+        raise NotImplementedError
+
+    def ack(self, msg_id: Any) -> None:
+        """Tuple tree for ``msg_id`` fully processed."""
+
+    def fail(self, msg_id: Any) -> None:
+        """Tuple tree failed or timed out; replayable spouts re-emit."""
+
+    def close(self) -> None:
+        pass
+
+    async def activate(self) -> None:
+        pass
+
+    async def deactivate(self) -> None:
+        pass
+
+
+class Bolt(Component):
+    def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
+        """One-time init per executor (InferenceBolt.java:44-62 loads the
+        model here). Heavy state belongs here, not in __init__: the topology
+        builder deep-copies the instance per task."""
+        self.context = context
+        self.collector = collector
+
+    async def execute(self, t: Tuple) -> None:
+        raise NotImplementedError
+
+    async def tick(self) -> None:
+        """Periodic timer callback (tick tuples, KafkaBolt.java:36)."""
+
+    def cleanup(self) -> None:
+        """Graceful shutdown (KafkaBolt.java:175-177 closes the producer)."""

@@ -1,0 +1,339 @@
+"""The in-process cluster: routing, lifecycle, rebalance, at-least-once sweep.
+
+Fills two roles from the reference stack (SURVEY.md §1):
+
+- Storm's cluster runtime (layer 1): executor scheduling, tuple transport,
+  ack/replay, supervision — here an asyncio runtime with bounded queues;
+- the ``LocalCluster`` test harness the reference never used (SURVEY.md §4
+  notes it tested only by running on a real cluster for an hour) — here the
+  *primary* way topologies run in tests.
+
+Also provides what the reference lacked: runtime ``rebalance`` (elastic
+parallelism — the reference's scaling knob is a compile-time constant,
+MainTopology.java:25-28), graceful drain instead of the fixed
+sleep-1h-then-hard-kill driver (MainTopology.java:71-77).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple as Tup
+
+from storm_tpu.config import Config
+from storm_tpu.runtime.acker import AckLedger
+from storm_tpu.runtime.executor import BoltExecutor, SpoutExecutor, clone_component
+from storm_tpu.runtime.metrics import MetricsRegistry
+from storm_tpu.runtime.topology import Topology
+
+log = logging.getLogger("storm_tpu.cluster")
+
+
+class TargetGroup:
+    """Mutable set of inboxes for one downstream component (mutable so
+    rebalance can swap instances under live producers)."""
+
+    def __init__(self, component_id: str) -> None:
+        self.component_id = component_id
+        self.inboxes: List[asyncio.Queue] = []
+
+
+class Router:
+    def __init__(self) -> None:
+        self._subs: Dict[Tup[str, str], List[Tup[Any, TargetGroup]]] = {}
+
+    def add(self, source: str, stream: str, grouping: Any, group: TargetGroup) -> None:
+        grouping.prepare(len(group.inboxes))
+        self._subs.setdefault((source, stream), []).append((grouping, group))
+
+    def subscriptions(self, source: str, stream: str) -> List[Tup[Any, TargetGroup]]:
+        return self._subs.get((source, stream), [])
+
+    def reprepare(self, component_id: str) -> None:
+        for subs in self._subs.values():
+            for grouping, group in subs:
+                if group.component_id == component_id:
+                    grouping.prepare(len(group.inboxes))
+
+
+class TopologyRuntime:
+    """Everything live for one submitted topology."""
+
+    def __init__(self, name: str, topology: Topology, config: Config) -> None:
+        self.name = name
+        self.topology = topology
+        self.config = config
+        self.metrics = MetricsRegistry()
+        self.ledger = AckLedger(timeout_s=config.topology.message_timeout_s)
+        self.router = Router()
+        self.groups: Dict[str, TargetGroup] = {}
+        self.bolt_execs: Dict[str, List[BoltExecutor]] = {}
+        self.spout_execs: Dict[str, List[SpoutExecutor]] = {}
+        self.errors: List[Tup[str, int, BaseException]] = []
+        self._sweeper: Optional[asyncio.Task] = None
+        self._error_cb: Optional[Callable] = None
+
+    # ---- wiring --------------------------------------------------------------
+
+    def _make_executors(self) -> None:
+        tcfg = self.config.topology
+        for spec in self.topology.specs.values():
+            group = TargetGroup(spec.component_id)
+            self.groups[spec.component_id] = group
+            if spec.is_spout:
+                execs = [
+                    SpoutExecutor(
+                        self,
+                        spec.component_id,
+                        i,
+                        clone_component(spec.obj),
+                        tcfg.max_spout_pending,
+                    )
+                    for i in range(spec.parallelism)
+                ]
+                self.spout_execs[spec.component_id] = execs
+            else:
+                execs = [
+                    BoltExecutor(
+                        self,
+                        spec.component_id,
+                        i,
+                        clone_component(spec.obj),
+                        tcfg.inbox_capacity,
+                        tcfg.tick_interval_s,
+                    )
+                    for i in range(spec.parallelism)
+                ]
+                self.bolt_execs[spec.component_id] = execs
+                group.inboxes = [e.inbox for e in execs]
+        for spec in self.topology.specs.values():
+            for sub in spec.inputs:
+                self.router.add(
+                    sub.source, sub.stream, sub.grouping, self.groups[spec.component_id]
+                )
+
+    async def start(self) -> None:
+        self._make_executors()
+        # Bolts first (downstream ready before data flows), then spouts.
+        for execs in self.bolt_execs.values():
+            for e in execs:
+                e.start()
+        for execs in self.spout_execs.values():
+            for e in execs:
+                e.start()
+        self._sweeper = asyncio.create_task(self._sweep_loop())
+
+    async def _sweep_loop(self) -> None:
+        interval = max(0.25, min(1.0, self.config.topology.message_timeout_s / 4))
+        while True:
+            await asyncio.sleep(interval)
+            n = self.ledger.sweep()
+            if n:
+                log.warning("%s: %d tuple trees timed out", self.name, n)
+
+    # ---- runtime services (used by collectors/executors) ---------------------
+
+    def parallelism_of(self, component_id: str) -> int:
+        if component_id in self.bolt_execs:
+            return len(self.bolt_execs[component_id])
+        if component_id in self.spout_execs:
+            return len(self.spout_execs[component_id])
+        return self.topology.specs[component_id].parallelism
+
+    def spout_done_cb(self, component_id: str, task_index: int):
+        ex = self.spout_execs[component_id][task_index]
+        ex.track()
+        return ex.on_done
+
+    def spout_done(self, component_id: str, task_index: int, msg_id, ok: bool, ts: float) -> None:
+        """Completion for roots that never entered the ledger (emit with no
+        subscribers). Keeps tree_acked/tree_failed accounting consistent with
+        the ledger path without touching the executor's inflight gate."""
+        ex = self.spout_execs[component_id][task_index]
+        self.metrics.counter(component_id, "tree_acked" if ok else "tree_failed").inc()
+        (ex.spout.ack if ok else ex.spout.fail)(msg_id)
+
+    def report_error(self, component_id: str, task_index: int, err: BaseException) -> None:
+        self.errors.append((component_id, task_index, err))
+        self.metrics.counter(component_id, "errors").inc()
+        log.error(
+            "error in %s[%d]: %r", component_id, task_index, err, exc_info=err
+        )
+        if self._error_cb is not None:
+            self._error_cb(component_id, task_index, err)
+
+    # ---- lifecycle -----------------------------------------------------------
+
+    async def deactivate(self) -> None:
+        """Stop spouts pulling; in-flight tuples keep flowing (Storm's
+        'deactivate' — first phase of a graceful drain)."""
+        for execs in self.spout_execs.values():
+            for e in execs:
+                e._active = False
+                await e.spout.deactivate()
+
+    async def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait for all in-flight tuple trees and inboxes to empty."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            busy = self.ledger.inflight > 0 or any(
+                not e.inbox.empty()
+                for execs in self.bolt_execs.values()
+                for e in execs
+            )
+            if not busy:
+                return True
+            await asyncio.sleep(0.01)
+        return False
+
+    async def kill(self, wait_secs: float = 0.0) -> None:
+        """Kill the topology. ``wait_secs`` mirrors Storm's KillOptions
+        (the reference sets wait_secs=0 for a hard kill,
+        MainTopology.java:74-76); >0 deactivates and drains first."""
+        if wait_secs > 0:
+            await self.deactivate()
+            await self.drain(timeout_s=wait_secs)
+        if self._sweeper:
+            self._sweeper.cancel()
+        for execs in self.spout_execs.values():
+            for e in execs:
+                await e.stop()
+        # Drain-stop bolts so queued tuples finish when killing gracefully.
+        for execs in self.bolt_execs.values():
+            for e in execs:
+                await e.stop(drain=wait_secs > 0)
+
+    # ---- elasticity ----------------------------------------------------------
+
+    async def rebalance(self, component_id: str, parallelism: int) -> None:
+        """Change a component's parallelism live — the framework op the
+        reference's README frames as 'rebuild with more bolts'
+        (README.md:13-14; SURVEY.md §2.4 elastic row)."""
+        if parallelism < 1:
+            raise ValueError("parallelism must be >= 1")
+        tcfg = self.config.topology
+        proto = self.topology.specs[component_id].obj
+        if component_id in self.bolt_execs:
+            execs = self.bolt_execs[component_id]
+            while len(execs) < parallelism:
+                e = BoltExecutor(
+                    self,
+                    component_id,
+                    len(execs),
+                    clone_component(proto),
+                    tcfg.inbox_capacity,
+                    tcfg.tick_interval_s,
+                )
+                execs.append(e)
+                e.start()
+            removed = []
+            while len(execs) > parallelism:
+                removed.append(execs.pop())
+            self.groups[component_id].inboxes = [e.inbox for e in execs]
+            self.router.reprepare(component_id)
+            for e in removed:
+                await e.stop(drain=True)
+        elif component_id in self.spout_execs:
+            execs = self.spout_execs[component_id]
+            while len(execs) < parallelism:
+                e = SpoutExecutor(
+                    self,
+                    component_id,
+                    len(execs),
+                    clone_component(proto),
+                    tcfg.max_spout_pending,
+                )
+                execs.append(e)
+                e.start()
+            while len(execs) > parallelism:
+                await execs.pop().stop()
+        else:
+            raise KeyError(component_id)
+        self.topology.specs[component_id].parallelism = parallelism
+
+
+class AsyncLocalCluster:
+    """Async-native cluster API (use inside an event loop / async tests)."""
+
+    def __init__(self) -> None:
+        self._topologies: Dict[str, TopologyRuntime] = {}
+
+    async def submit(self, name: str, config: Config, topology: Topology) -> TopologyRuntime:
+        if name in self._topologies:
+            raise ValueError(f"topology {name!r} already running")
+        topology.validate()
+        rt = TopologyRuntime(name, topology, config)
+        self._topologies[name] = rt
+        await rt.start()
+        return rt
+
+    def runtime(self, name: str) -> TopologyRuntime:
+        return self._topologies[name]
+
+    async def kill(self, name: str, wait_secs: float = 0.0) -> None:
+        rt = self._topologies.pop(name)
+        await rt.kill(wait_secs)
+
+    async def shutdown(self) -> None:
+        for name in list(self._topologies):
+            await self.kill(name, wait_secs=0.0)
+
+
+class LocalCluster:
+    """Synchronous facade over :class:`AsyncLocalCluster`, running its own
+    event loop in a background thread — the drop-in equivalent of Storm's
+    ``LocalCluster`` for scripts and notebooks."""
+
+    def __init__(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="storm-tpu-cluster", daemon=True
+        )
+        self._thread.start()
+        self._cluster = AsyncLocalCluster()
+
+    def _run(self, coro, timeout: Optional[float] = None):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
+
+    def submit_topology(self, name: str, config: Config, topology: Topology) -> None:
+        self._run(self._cluster.submit(name, config, topology))
+
+    def kill_topology(self, name: str, wait_secs: float = 0.0) -> None:
+        self._run(self._cluster.kill(name, wait_secs))
+
+    def rebalance(self, name: str, component_id: str, parallelism: int) -> None:
+        self._run(self._cluster.runtime(name).rebalance(component_id, parallelism))
+
+    def deactivate(self, name: str) -> None:
+        self._run(self._cluster.runtime(name).deactivate())
+
+    def drain(self, name: str, timeout_s: float = 30.0) -> bool:
+        return self._run(self._cluster.runtime(name).drain(timeout_s))
+
+    def metrics(self, name: str) -> Dict[str, Dict[str, object]]:
+        # Marshal onto the loop thread: snapshot() iterates dicts the
+        # executors mutate there.
+        async def snap():
+            return self._cluster.runtime(name).metrics.snapshot()
+
+        return self._run(snap())
+
+    def errors(self, name: str) -> List[Tup[str, int, BaseException]]:
+        async def errs():
+            return list(self._cluster.runtime(name).errors)
+
+        return self._run(errs())
+
+    def shutdown(self) -> None:
+        self._run(self._cluster.shutdown())
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+        self._loop.close()
+
+    def __enter__(self) -> "LocalCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

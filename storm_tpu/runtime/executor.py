@@ -1,0 +1,214 @@
+"""Executors: one asyncio task per operator instance.
+
+The runtime equivalent of Storm's executor threads (SURVEY.md §1 layer 1).
+Each bolt instance owns a bounded inbox queue — the backpressure point that
+replaces Storm's Disruptor queues — and each spout instance runs a pull loop
+gated on ``max_spout_pending`` (Storm's ``topology.max.spout.pending``).
+Single ownership per instance: no shared mutable state between executors,
+which is what makes the reference's mutable-POJO-reuse hazard
+(InferenceBolt.java:34-35, SURVEY.md §5.2) structurally impossible here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import logging
+import traceback
+from typing import Any, Optional
+
+from storm_tpu.runtime.base import Bolt, OutputCollector, Spout, TopologyContext
+from storm_tpu.runtime.tuples import TickTuple, Tuple, is_tick
+
+log = logging.getLogger("storm_tpu.executor")
+
+_STOP = object()  # inbox sentinel
+
+
+class BoltExecutor:
+    def __init__(
+        self,
+        runtime: Any,
+        component_id: str,
+        task_index: int,
+        bolt: Bolt,
+        inbox_capacity: int,
+        tick_interval_s: float = 0.0,
+    ) -> None:
+        self.rt = runtime
+        self.component_id = component_id
+        self.task_index = task_index
+        self.bolt = bolt
+        self.inbox: asyncio.Queue = asyncio.Queue(maxsize=inbox_capacity)
+        self.tick_interval_s = tick_interval_s
+        self._task: Optional[asyncio.Task] = None
+        self._tick_task: Optional[asyncio.Task] = None
+        self.collector = OutputCollector(runtime, component_id, task_index)
+        self.collector.set_output_fields(bolt.declare_output_fields())
+
+    def start(self) -> None:
+        ctx = TopologyContext(
+            self.component_id,
+            self.task_index,
+            self.rt.parallelism_of(self.component_id),
+            self.rt.config,
+            self.rt.metrics,
+        )
+        self.bolt.prepare(ctx, self.collector)
+        self._task = asyncio.create_task(
+            self._run(), name=f"{self.component_id}[{self.task_index}]"
+        )
+        interval = self.tick_interval_s or getattr(self.bolt, "tick_interval_s", 0.0)
+        if interval > 0:
+            self._tick_task = asyncio.create_task(self._ticker(interval))
+
+    async def _ticker(self, interval: float) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            # Non-blocking: a full inbox skips the tick rather than stalling.
+            try:
+                self.inbox.put_nowait(TickTuple())
+            except asyncio.QueueFull:
+                pass
+
+    async def _run(self) -> None:
+        m = self.rt.metrics
+        executed = m.counter(self.component_id, "executed")
+        while True:
+            item = await self.inbox.get()
+            if item is _STOP:
+                break
+            t: Tuple = item
+            try:
+                if is_tick(t):
+                    await self.bolt.tick()
+                else:
+                    executed.inc()
+                    await self.bolt.execute(t)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # fail the tuple, keep the executor alive
+                self.rt.report_error(self.component_id, self.task_index, e)
+                if not is_tick(t):
+                    self.collector.fail(t)
+
+    async def stop(self, drain: bool) -> None:
+        if self._tick_task:
+            self._tick_task.cancel()
+        if self._task is None:
+            return
+        if drain:
+            await self.inbox.put(_STOP)
+            try:
+                await asyncio.wait_for(self._task, timeout=30.0)
+            except asyncio.TimeoutError:  # pragma: no cover
+                self._task.cancel()
+        else:
+            self._task.cancel()
+        try:
+            await self._task
+        except (asyncio.CancelledError, Exception):
+            pass
+        try:
+            self.bolt.cleanup()
+        except Exception as e:  # pragma: no cover
+            log.warning("cleanup error in %s: %s", self.component_id, e)
+
+
+class SpoutExecutor:
+    def __init__(
+        self,
+        runtime: Any,
+        component_id: str,
+        task_index: int,
+        spout: Spout,
+        max_pending: int,
+    ) -> None:
+        self.rt = runtime
+        self.component_id = component_id
+        self.task_index = task_index
+        self.spout = spout
+        self.max_pending = max_pending
+        self.inflight = 0
+        self._slot = asyncio.Event()
+        self._slot.set()
+        self._task: Optional[asyncio.Task] = None
+        self._active = True
+        self.collector = OutputCollector(runtime, component_id, task_index)
+        self.collector.set_output_fields(spout.declare_output_fields())
+
+    def on_done(self, msg_id: Any, ok: bool, root_ts: float) -> None:
+        """Ledger callback: tuple tree for msg_id completed or failed."""
+        self.inflight -= 1
+        if self.inflight < self.max_pending:
+            self._slot.set()
+        m = self.rt.metrics
+        if ok:
+            m.counter(self.component_id, "tree_acked").inc()
+            self.spout.ack(msg_id)
+        else:
+            m.counter(self.component_id, "tree_failed").inc()
+            self.spout.fail(msg_id)
+
+    def track(self) -> None:
+        """Called by the runtime when this spout opens a ledger entry."""
+        self.inflight += 1
+        if self.inflight >= self.max_pending:
+            self._slot.clear()
+
+    def start(self) -> None:
+        ctx = TopologyContext(
+            self.component_id,
+            self.task_index,
+            self.rt.parallelism_of(self.component_id),
+            self.rt.config,
+            self.rt.metrics,
+        )
+        self.spout.open(ctx, self.collector)
+        self._task = asyncio.create_task(
+            self._run(), name=f"{self.component_id}[{self.task_index}]"
+        )
+
+    async def _run(self) -> None:
+        idle_backoff = 0.001
+        while True:
+            await self._slot.wait()
+            if not self._active:
+                await asyncio.sleep(0.05)
+                continue
+            try:
+                emitted = await self.spout.next_tuple()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.rt.report_error(self.component_id, self.task_index, e)
+                emitted = False
+            if not emitted:
+                await asyncio.sleep(idle_backoff)
+                idle_backoff = min(idle_backoff * 2, 0.05)
+            else:
+                idle_backoff = 0.001
+
+    async def stop(self) -> None:
+        if self._task is None:
+            return
+        self._task.cancel()
+        try:
+            await self._task
+        except (asyncio.CancelledError, Exception):
+            pass
+        try:
+            self.spout.close()
+        except Exception as e:  # pragma: no cover
+            log.warning("close error in %s: %s", self.component_id, e)
+
+
+def clone_component(obj: Any) -> Any:
+    """Per-task instance from the prototype the user handed the builder.
+
+    Storm gets per-executor instances by serialize/deserialize of the
+    submitted bolt; we deep-copy. Components may define ``clone()`` to
+    customize (e.g., to share a read-only model artifact)."""
+    if hasattr(obj, "clone"):
+        return obj.clone()
+    return copy.deepcopy(obj)

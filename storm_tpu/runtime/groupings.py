@@ -1,0 +1,74 @@
+"""Stream groupings: how emitted tuples pick downstream executor instances.
+
+The reference uses only ``shuffleGrouping`` (MainTopology.java:62-63); the
+full Storm grouping family is reproduced here so topologies beyond the
+reference's shape can be expressed (fields/all/global/direct/local-or-shuffle).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Sequence
+
+from storm_tpu.runtime.tuples import Tuple
+
+
+class Grouping:
+    """Chooses target instance indices among ``n`` downstream executors."""
+
+    def prepare(self, n: int) -> None:
+        self.n = n
+
+    def choose(self, t: Tuple) -> Sequence[int]:
+        raise NotImplementedError
+
+
+class ShuffleGrouping(Grouping):
+    """Round-robin from a random start — Storm's shuffle: uniform load,
+    no key affinity (MainTopology.java:62-63)."""
+
+    def prepare(self, n: int) -> None:
+        self.n = n
+        self._i = random.randrange(n) if n else 0
+
+    def choose(self, t: Tuple) -> Sequence[int]:
+        self._i = (self._i + 1) % self.n
+        return (self._i,)
+
+
+class LocalOrShuffleGrouping(ShuffleGrouping):
+    """In-process runtime: identical to shuffle (everything is local)."""
+
+
+class FieldsGrouping(Grouping):
+    """Hash partition on selected fields: same key -> same instance."""
+
+    def __init__(self, *field_names: str) -> None:
+        if not field_names:
+            raise ValueError("fields grouping needs at least one field name")
+        self.field_names = field_names
+
+    def choose(self, t: Tuple) -> Sequence[int]:
+        key = tuple(t.get(f) for f in self.field_names)
+        return (hash(key) % self.n,)
+
+
+class AllGrouping(Grouping):
+    """Broadcast to every instance."""
+
+    def choose(self, t: Tuple) -> Sequence[int]:
+        return range(self.n)
+
+
+class GlobalGrouping(Grouping):
+    """Everything to instance 0."""
+
+    def choose(self, t: Tuple) -> Sequence[int]:
+        return (0,)
+
+
+class DirectGrouping(Grouping):
+    """Producer names the target instance via ``emit_direct``."""
+
+    def choose(self, t: Tuple) -> Sequence[int]:  # pragma: no cover
+        raise RuntimeError("direct grouping requires emit_direct(task, ...)")

@@ -1,0 +1,117 @@
+"""Metrics: counters, gauges, latency histograms with percentiles.
+
+The reference exposed nothing beyond Storm UI's built-ins (SURVEY.md §5.1,
+§5.5). Here metrics are first-class: every component gets tuples-in/out,
+ack/fail counters; the inference operator records batch sizes and device
+time; the sink records end-to-end (ingress->egress) latency — the
+north-star Kafka->Kafka metric (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+
+class Histogram:
+    """Ring-buffer reservoir; percentiles over the most recent window."""
+
+    def __init__(self, capacity: int = 65536) -> None:
+        self._buf = np.zeros(capacity, dtype=np.float64)
+        self._n = 0
+        self._i = 0
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        self._buf[self._i] = v
+        self._i = (self._i + 1) % len(self._buf)
+        self._n = min(self._n + 1, len(self._buf))
+        self.count += 1
+        self.sum += v
+
+    def percentile(self, q: float) -> float:
+        if self._n == 0:
+            return float("nan")
+        return float(np.percentile(self._buf[: self._n], q))
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else float("nan")
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+
+class MetricsRegistry:
+    """Per-topology registry: ``(component, name) -> metric``. Thread-safe
+    creation (the gRPC worker and device threads may record concurrently)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[tuple, Counter] = {}
+        self._gauges: Dict[tuple, Gauge] = {}
+        self._histograms: Dict[tuple, Histogram] = {}
+
+    def counter(self, component: str, name: str) -> Counter:
+        key = (component, name)
+        c = self._counters.get(key)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(key, Counter())
+        return c
+
+    def gauge(self, component: str, name: str) -> Gauge:
+        key = (component, name)
+        g = self._gauges.get(key)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(key, Gauge())
+        return g
+
+    def histogram(self, component: str, name: str) -> Histogram:
+        key = (component, name)
+        h = self._histograms.get(key)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(key, Histogram())
+        return h
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        out: Dict[str, Dict[str, object]] = {}
+        for (comp, name), c in list(self._counters.items()):
+            out.setdefault(comp, {})[name] = c.value
+        for (comp, name), g in list(self._gauges.items()):
+            out.setdefault(comp, {})[name] = g.value
+        for (comp, name), h in list(self._histograms.items()):
+            out.setdefault(comp, {})[name] = h.snapshot()
+        return out

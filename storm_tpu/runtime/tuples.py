@@ -1,0 +1,90 @@
+"""Tuples and ack identities.
+
+The unit of data flow, equivalent to Storm's ``Tuple`` (consumed at
+InferenceBolt.java:70-71 via ``tuple.getString(0)``; produced via
+``new Values(outputJson)`` at :98). Carries the XOR ack identity used by the
+at-least-once ledger (:mod:`storm_tpu.runtime.acker`): every tuple edge has a
+random 64-bit ``edge_id``; a tuple anchored to one or more root (spout)
+tuples propagates their ``anchors`` set, exactly like Storm's anchoring model
+that the reference relies on (SURVEY.md §2.5, §5.3).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+from typing import Any, FrozenSet, Optional, Sequence
+
+
+def new_id() -> int:
+    """Random non-zero 64-bit id (zero is the acker's 'complete' value)."""
+    while True:
+        v = secrets.randbits(64)
+        if v:
+            return v
+
+
+class Values(list):
+    """An emitted value list, mirroring Storm's ``Values`` for familiarity."""
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=1024)
+def _field_index(fields: tuple) -> dict:
+    return {name: i for i, name in enumerate(fields)}
+
+
+@dataclass
+class Tuple:
+    values: Sequence[Any]
+    fields: Sequence[str]
+    source_component: str
+    source_task: int = 0
+    stream: str = "default"
+    edge_id: int = 0
+    anchors: FrozenSet[int] = frozenset()
+    # perf_counter timestamp when the root entered the topology; flows with
+    # the tuple for end-to-end latency metrics.
+    root_ts: float = 0.0
+
+    def __getitem__(self, i: int) -> Any:
+        return self.values[i]
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def get(self, name: str) -> Any:
+        """Field access by declared name (Storm's ``getValueByField``).
+
+        O(1): the field->index map is cached per distinct fields tuple
+        (fields objects are shared across every tuple of a stream), and
+        this is on the per-tuple hot path (groupings, sink mapping).
+        """
+        idx = _field_index(tuple(self.fields)).get(name)
+        if idx is None:
+            raise KeyError(
+                f"no field {name!r} in stream from {self.source_component} "
+                f"(fields: {list(self.fields)})"
+            )
+        return self.values[idx]
+
+    def get_string(self, i: int) -> str:
+        """Storm's ``tuple.getString(i)`` (InferenceBolt.java:71)."""
+        return str(self.values[i])
+
+
+class TickTuple(Tuple):
+    """Periodic timer tuple, equivalent to Storm's tick tuples that the
+    reference's KafkaBolt filters via ``BaseTickTupleAwareRichBolt``
+    (KafkaBolt.java:36)."""
+
+    def __init__(self) -> None:
+        super().__init__(
+            values=(), fields=(), source_component="__system", stream="__tick"
+        )
+
+
+def is_tick(t: Tuple) -> bool:
+    return t.stream == "__tick"

@@ -1,0 +1,3 @@
+from storm_tpu.utils.logging import setup_logging
+
+__all__ = ["setup_logging"]

@@ -1,0 +1,15 @@
+"""Logging setup (the reference used commons-logging/slf4j defaults;
+here one call configures structured, rate-friendly logs)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def setup_logging(level: str = "") -> None:
+    level = level or os.environ.get("STORM_TPU_LOG", "INFO")
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )

@@ -1,0 +1,26 @@
+"""Test env: force JAX onto CPU with 8 virtual devices BEFORE jax imports,
+so sharding/mesh tests run without TPU hardware (SURVEY.md §4 build
+obligation: fake/CPU backend for multi-device simulation)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio
+
+import pytest
+
+
+@pytest.fixture
+def run():
+    """Run a coroutine to completion on a fresh event loop."""
+
+    def _run(coro, timeout=60.0):
+        return asyncio.run(asyncio.wait_for(coro, timeout))
+
+    return _run

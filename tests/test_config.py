@@ -1,0 +1,69 @@
+import json
+
+import pytest
+
+from storm_tpu.config import BatchConfig, Config, OffsetsConfig, SinkConfig
+
+
+def test_defaults_mirror_reference_constants():
+    # MainTopology.java:25-28 — 2 spouts / 4 inference / 2 sinks.
+    cfg = Config()
+    assert cfg.topology.spout_parallelism == 2
+    assert cfg.topology.inference_parallelism == 4
+    assert cfg.topology.sink_parallelism == 2
+    # Reference freshness semantics (MainTopology.java:101-103).
+    assert cfg.offsets.policy == "latest"
+    assert cfg.offsets.max_behind == 0
+    # KafkaBolt defaults (KafkaBolt.java:50-54): async, not fire-and-forget.
+    assert cfg.sink.mode == "async"
+
+
+def test_bucket_selection():
+    b = BatchConfig(max_batch=64, buckets=(8, 16, 64))
+    assert b.bucket_for(1) == 8
+    assert b.bucket_for(9) == 16
+    assert b.bucket_for(64) == 64
+    assert b.bucket_for(1000) == 64
+
+
+def test_buckets_normalized():
+    b = BatchConfig(max_batch=32, buckets=(64, 8))
+    assert b.buckets[-1] == 32
+    assert 64 not in b.buckets
+
+
+def test_apply_dict_and_overrides():
+    cfg = Config.from_dict({"topology": {"inference_parallelism": 8}})
+    assert cfg.topology.inference_parallelism == 8
+    cfg.apply_overrides(["model.name=resnet20", "batch.max_batch=128"])
+    assert cfg.model.name == "resnet20"
+    assert cfg.batch.max_batch == 128
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(KeyError):
+        Config.from_dict({"topology": {"nope": 1}})
+    with pytest.raises(KeyError):
+        Config.from_dict({"nope": {}})
+
+
+def test_invalid_enum_values():
+    with pytest.raises(ValueError):
+        OffsetsConfig(policy="bogus")
+    with pytest.raises(ValueError):
+        SinkConfig(mode="bogus")
+
+
+def test_load_json(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"broker": {"input_topic": "in-x"}}))
+    cfg = Config.load(p)
+    assert cfg.broker.input_topic == "in-x"
+
+
+def test_load_toml(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text('[model]\nname = "vit_b16"\nnum_classes = 1000\n')
+    cfg = Config.load(p)
+    assert cfg.model.name == "vit_b16"
+    assert cfg.model.num_classes == 1000

@@ -1,0 +1,198 @@
+"""Connector tests: memory broker semantics, spout offset policies,
+sink ack modes (reference KafkaSpout config MainTopology.java:95-106 and
+KafkaBolt.java:116-166)."""
+
+import asyncio
+
+import pytest
+
+from storm_tpu.config import Config, OffsetsConfig, SinkConfig
+from storm_tpu.connectors import BrokerSink, BrokerSpout, MemoryBroker
+from storm_tpu.connectors.sink import Producer
+from storm_tpu.runtime import TopologyBuilder
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+
+# ---- broker ------------------------------------------------------------------
+
+
+def test_broker_produce_fetch_offsets():
+    b = MemoryBroker(default_partitions=2)
+    for i in range(10):
+        b.produce("t", f"v{i}")
+    assert b.topic_size("t") == 10
+    total = sum(len(b.fetch("t", p, 0, 100)) for p in range(2))
+    assert total == 10
+    assert b.latest_offset("t", 0) + b.latest_offset("t", 1) == 10
+
+
+def test_broker_key_partition_affinity():
+    b = MemoryBroker(default_partitions=4)
+    parts = {b.produce("t", "v", key="samekey")[0] for _ in range(10)}
+    assert len(parts) == 1
+
+
+def test_broker_commit_roundtrip():
+    b = MemoryBroker()
+    assert b.committed("g", "t", 0) is None
+    b.commit("g", "t", 0, 7)
+    assert b.committed("g", "t", 0) == 7
+
+
+# ---- spout policies ----------------------------------------------------------
+
+
+async def _spout_run(broker, offsets, produce_before, produce_after, wait=1.0):
+    from tests.test_runtime import CaptureBolt
+
+    CaptureBolt.seen = None
+    for v in produce_before:
+        broker.produce("in", v)
+    cluster = AsyncLocalCluster()
+    tb = TopologyBuilder()
+    tb.set_spout("spout", BrokerSpout(broker, "in", offsets), 2)
+    tb.set_bolt("cap", CaptureBolt(), 2).shuffle_grouping("spout")
+    rt = await cluster.submit("t", Config(), tb.build())
+    await asyncio.sleep(0.1)
+    for v in produce_after:
+        broker.produce("in", v)
+    deadline = asyncio.get_event_loop().time() + wait
+    while asyncio.get_event_loop().time() < deadline:
+        if CaptureBolt.seen and len(CaptureBolt.seen) >= len(produce_after) + len(
+            produce_before
+        ):
+            break
+        await asyncio.sleep(0.02)
+    await rt.drain(timeout_s=5)
+    seen = sorted(m for _, m in (CaptureBolt.seen or []))
+    await cluster.shutdown()
+    return seen
+
+
+def test_latest_policy_skips_backlog(run):
+    """Reference semantics: start at log end — backlog invisible
+    (MainTopology.java:101-103)."""
+    broker = MemoryBroker(default_partitions=2)
+    seen = run(
+        _spout_run(
+            broker,
+            OffsetsConfig(policy="latest", max_behind=0),
+            produce_before=["old1", "old2"],
+            produce_after=["new1", "new2", "new3"],
+        )
+    )
+    assert seen == ["new1", "new2", "new3"]
+
+
+def test_earliest_policy_replays_backlog(run):
+    broker = MemoryBroker(default_partitions=2)
+    seen = run(
+        _spout_run(
+            broker,
+            OffsetsConfig(policy="earliest", max_behind=None),
+            produce_before=["a", "b"],
+            produce_after=["c"],
+        )
+    )
+    assert seen == ["a", "b", "c"]
+
+
+def test_resume_policy_commits_and_resumes(run):
+    broker = MemoryBroker(default_partitions=1)
+    offsets = OffsetsConfig(policy="resume", max_behind=None, group_id="g1")
+    seen1 = run(
+        _spout_run(broker, offsets, produce_before=["a", "b"], produce_after=[])
+    )
+    assert seen1 == ["a", "b"]
+    # Second run with same group resumes after committed offset.
+    seen2 = run(
+        _spout_run(broker, offsets, produce_before=[], produce_after=["c", "d"])
+    )
+    assert seen2 == ["c", "d"]
+    assert broker.committed("g1", "in", 0) == 4
+
+
+# ---- sink ack modes ----------------------------------------------------------
+
+
+class FlakyProducer(Producer):
+    """Fails the first N sends."""
+
+    def __init__(self, broker, fail_first=0):
+        self.broker = broker
+        self.fail_first = fail_first
+        self.sent = 0
+
+    async def send(self, topic, value, key):
+        if self.sent < self.fail_first:
+            self.sent += 1
+            raise IOError("delivery failed")
+        self.sent += 1
+        self.broker.produce(topic, value, key)
+
+
+def _sink_with(broker, mode, fail_first=0):
+    class TestSink(BrokerSink):
+        def make_producer(self):  # the mkProducer test seam
+            return FlakyProducer(broker, fail_first)
+
+    return TestSink(broker, "out", SinkConfig(mode=mode))
+
+
+async def _sink_run(broker, sink, items):
+    from tests.test_runtime import ListSpout
+
+    cluster = AsyncLocalCluster()
+    tb = TopologyBuilder()
+    spout = ListSpout(items)
+    tb.set_spout("s", spout, 1)
+    tb.set_bolt("sink", sink, 1).shuffle_grouping("s")
+    rt = await cluster.submit("t", Config(), tb.build())
+    deadline = asyncio.get_event_loop().time() + 5
+    while asyncio.get_event_loop().time() < deadline:
+        live = rt.spout_execs["s"][0].spout
+        if len(live.acked) + len(live.failed) >= len(items):
+            break
+        await asyncio.sleep(0.01)
+    await asyncio.sleep(0.05)  # let async send tasks settle
+    live = rt.spout_execs["s"][0].spout
+    res = (list(live.acked), list(live.failed))
+    await cluster.shutdown()
+    return res
+
+
+@pytest.mark.parametrize("mode", ["async", "sync"])
+def test_sink_delivery_ack(run, mode):
+    broker = MemoryBroker()
+    acked, failed = run(_sink_run(broker, _sink_with(broker, mode), ["a", "b"]))
+    assert sorted(acked) == ["a", "b"] and failed == []
+    assert broker.topic_size("out") == 2
+
+
+@pytest.mark.parametrize("mode", ["async", "sync"])
+def test_sink_delivery_failure_fails_tuple(run, mode):
+    """Producer error -> tuple failed -> spout replay (KafkaBolt.java:137)."""
+    broker = MemoryBroker()
+    acked, failed = run(
+        _sink_run(broker, _sink_with(broker, mode, fail_first=1), ["a"])
+    )
+    assert failed == ["a"] and acked == []
+    assert broker.topic_size("out") == 0
+
+
+def test_sink_fire_and_forget_acks_despite_failure(run):
+    """fire-and-forget acks immediately, errors dropped (KafkaBolt.java:153-155)."""
+    broker = MemoryBroker()
+    acked, failed = run(
+        _sink_run(broker, _sink_with(broker, "fire_and_forget", fail_first=1), ["a"])
+    )
+    assert acked == ["a"] and failed == []
+
+
+def test_sink_null_topic_warns_and_acks(run):
+    """None topic -> ack without send (KafkaBolt.java:156-159)."""
+    broker = MemoryBroker()
+    sink = BrokerSink(broker, None, SinkConfig(mode="sync"))
+    acked, failed = run(_sink_run(broker, sink, ["a"]))
+    assert acked == ["a"] and failed == []
+    assert broker.topic_size("out") == 0

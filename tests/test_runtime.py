@@ -1,0 +1,329 @@
+"""Runtime-core tests: topology DSL, groupings, XOR acker, replay, rebalance.
+
+Covers the Storm-layer semantics the reference inherits from storm-core
+(SURVEY.md §1 layer 1, §2.5) using the in-process cluster the reference
+never had (§4)."""
+
+import asyncio
+
+import pytest
+
+from storm_tpu.config import Config
+from storm_tpu.runtime import (
+    Bolt,
+    LocalCluster,
+    Spout,
+    TopologyBuilder,
+    Tuple,
+    Values,
+)
+from storm_tpu.runtime.acker import AckLedger
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+from storm_tpu.runtime.tuples import new_id
+
+
+class ListSpout(Spout):
+    """Emits each item once; tracks acks/fails; replays failures once."""
+
+    def __init__(self, items, replay_on_fail=False):
+        self.items = list(items)
+        self.replay_on_fail = replay_on_fail
+
+    def open(self, context, collector):
+        super().open(context, collector)
+        self.queue = list(self.items) if context.task_index == 0 else []
+        self.acked, self.failed = [], []
+
+    async def next_tuple(self):
+        if not self.queue:
+            return False
+        item = self.queue.pop(0)
+        await self.collector.emit(Values([item]), msg_id=item)
+        return True
+
+    def ack(self, msg_id):
+        self.acked.append(msg_id)
+
+    def fail(self, msg_id):
+        self.failed.append(msg_id)
+        if self.replay_on_fail:
+            self.queue.append(msg_id)
+            self.replay_on_fail = False  # replay once only
+
+
+class CaptureBolt(Bolt):
+    seen = None  # class-level capture across deep-copied instances
+
+    def prepare(self, context, collector):
+        super().prepare(context, collector)
+        if CaptureBolt.seen is None:
+            CaptureBolt.seen = []
+
+    async def execute(self, t):
+        CaptureBolt.seen.append((self.context.task_index, t.get("message")))
+        self.collector.ack(t)
+
+
+class PassBolt(Bolt):
+    async def execute(self, t):
+        await self.collector.emit(Values([t.get("message")]), anchors=[t])
+        self.collector.ack(t)
+
+
+class FailOnceBolt(Bolt):
+    failed_once = False
+
+    async def execute(self, t):
+        if not FailOnceBolt.failed_once:
+            FailOnceBolt.failed_once = True
+            self.collector.fail(t)
+            return
+        self.collector.ack(t)
+
+
+class ExplodingBolt(Bolt):
+    async def execute(self, t):
+        raise RuntimeError("boom")
+
+
+# ---- ledger unit tests -------------------------------------------------------
+
+
+def test_ledger_basic_ack():
+    led = AckLedger(timeout_s=0)
+    done = []
+    root = new_id()
+    led.init_root(root, "m1", lambda m, ok, ts: done.append((m, ok)), 0.0)
+    e1 = new_id()
+    led.xor(root, e1)  # emit edge
+    assert led.inflight == 1
+    led.xor(root, e1)  # ack edge
+    assert led.inflight == 0
+    assert done == [("m1", True)]
+    assert led.acked == 1
+
+
+def test_ledger_multi_edge_tree():
+    led = AckLedger(timeout_s=0)
+    done = []
+    root = new_id()
+    led.init_root(root, "m", lambda m, ok, ts: done.append(ok), 0.0)
+    e1, e2, e3 = new_id(), new_id(), new_id()
+    led.xor(root, e1)          # spout -> boltA
+    led.xor(root, e2)          # boltA emits child to boltB
+    led.xor(root, e3)          # boltA emits child to boltC
+    led.xor(root, e1)          # boltA acks input
+    assert not done
+    led.xor(root, e2)
+    led.xor(root, e3)
+    assert done == [True]
+
+
+def test_ledger_fail_and_timeout():
+    led = AckLedger(timeout_s=0.01)
+    done = []
+    r1, r2 = new_id(), new_id()
+    led.init_root(r1, "a", lambda m, ok, ts: done.append((m, ok)), 0.0)
+    led.xor(r1, new_id())
+    led.fail_root(r1)
+    assert done == [("a", False)]
+    led.init_root(r2, "b", lambda m, ok, ts: done.append((m, ok)), 0.0)
+    led.xor(r2, new_id())
+    import time
+
+    time.sleep(0.03)
+    assert led.sweep() == 1
+    assert done[-1] == ("b", False)
+
+
+# ---- topology DSL ------------------------------------------------------------
+
+
+def test_builder_validation():
+    b = TopologyBuilder()
+    b.set_spout("s", ListSpout([]), 1)
+    b.set_bolt("x", CaptureBolt(), 1).shuffle_grouping("nope")
+    with pytest.raises(ValueError):
+        b.build()
+
+    b2 = TopologyBuilder()
+    b2.set_spout("s", ListSpout([]), 1)
+    with pytest.raises(ValueError):
+        b2.set_spout("s", ListSpout([]), 1)
+    with pytest.raises(ValueError):
+        b2.set_bolt("__sys", CaptureBolt(), 1)
+
+
+# ---- end-to-end through the async cluster ------------------------------------
+
+
+async def settle(rt, spout_id, n_items, timeout=10.0):
+    """Wait until every spout-emitted tree completed (acked or failed)."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        live = rt.spout_execs[spout_id][0].spout
+        if len(live.acked) + len(live.failed) >= n_items:
+            await rt.drain(timeout_s=timeout)
+            return True
+        await asyncio.sleep(0.01)
+    return False
+
+
+async def _run_simple(items, bolt, parallelism=2, cfg=None):
+    cfg = cfg or Config()
+    cluster = AsyncLocalCluster()
+    b = TopologyBuilder()
+    spout = ListSpout(items)
+    b.set_spout("spout", spout, 1)
+    b.set_bolt("bolt", bolt, parallelism).shuffle_grouping("spout")
+    rt = await cluster.submit("t", cfg, b.build())
+    ok = await settle(rt, "spout", len(items))
+    # find the live spout instance to inspect acks
+    live_spout = rt.spout_execs["spout"][0].spout
+    await cluster.shutdown()
+    return ok, live_spout, rt
+
+
+def test_shuffle_delivers_all_and_acks(run):
+    CaptureBolt.seen = None
+    items = [f"m{i}" for i in range(50)]
+    ok, spout, rt = run(_run_simple(items, CaptureBolt(), parallelism=3))
+    assert ok
+    assert sorted(m for _, m in CaptureBolt.seen) == sorted(items)
+    assert sorted(spout.acked) == sorted(items)
+    assert spout.failed == []
+    # shuffle spreads across instances
+    tasks = {t for t, _ in CaptureBolt.seen}
+    assert len(tasks) == 3
+
+
+def test_multi_hop_anchoring(run):
+    """spout -> pass -> capture: tree acked only after both hops ack."""
+    CaptureBolt.seen = None
+
+    async def go():
+        cluster = AsyncLocalCluster()
+        b = TopologyBuilder()
+        spout = ListSpout(["a", "b", "c"])
+        b.set_spout("s", spout, 1)
+        b.set_bolt("mid", PassBolt(), 2).shuffle_grouping("s")
+        b.set_bolt("end", CaptureBolt(), 2).shuffle_grouping("mid")
+        rt = await cluster.submit("t", Config(), b.build())
+        assert await settle(rt, "s", 3)
+        acked = list(rt.spout_execs["s"][0].spout.acked)
+        await cluster.shutdown()
+        return acked
+
+    acked = run(go())
+    assert sorted(acked) == ["a", "b", "c"]
+    assert sorted(m for _, m in CaptureBolt.seen) == ["a", "b", "c"]
+
+
+def test_explicit_fail_reaches_spout(run):
+    FailOnceBolt.failed_once = False
+    ok, spout, rt = run(_run_simple(["x"], FailOnceBolt(), parallelism=1))
+    assert ok
+    assert spout.failed == ["x"]
+
+
+def test_uncaught_exception_fails_tuple(run):
+    ok, spout, rt = run(_run_simple(["x", "y"], ExplodingBolt(), parallelism=1))
+    assert ok
+    assert sorted(spout.failed) == ["x", "y"]
+    assert spout.acked == []
+    assert len(rt.errors) == 2
+
+
+def test_replay_after_fail(run):
+    """Failed msg_id replayed by the spout completes on second attempt."""
+    FailOnceBolt.failed_once = False
+
+    async def go():
+        cluster = AsyncLocalCluster()
+        b = TopologyBuilder()
+        spout = ListSpout(["r"], replay_on_fail=True)
+        b.set_spout("s", spout, 1)
+        b.set_bolt("f", FailOnceBolt(), 1).shuffle_grouping("s")
+        rt = await cluster.submit("t", Config(), b.build())
+        for _ in range(200):
+            live = rt.spout_execs["s"][0].spout
+            if live.acked:
+                break
+            await asyncio.sleep(0.02)
+        live = rt.spout_execs["s"][0].spout
+        res = (list(live.acked), list(live.failed))
+        await cluster.shutdown()
+        return res
+
+    acked, failed = run(go())
+    assert failed == ["r"]
+    assert acked == ["r"]
+
+
+def test_fields_grouping_affinity(run):
+    """Same key always lands on the same task."""
+
+    class KeySpout(ListSpout):
+        def declare_output_fields(self):
+            return {"default": ("message",)}
+
+    CaptureBolt.seen = None
+
+    async def go():
+        cluster = AsyncLocalCluster()
+        b = TopologyBuilder()
+        items = [f"k{i % 4}" for i in range(40)]
+        b.set_spout("s", KeySpout(items), 1)
+        b.set_bolt("c", CaptureBolt(), 4).fields_grouping("s", "message")
+        rt = await cluster.submit("t", Config(), b.build())
+        assert await settle(rt, "s", 40)
+        await cluster.shutdown()
+
+    run(go())
+    owner = {}
+    for task, msg in CaptureBolt.seen:
+        assert owner.setdefault(msg, task) == task
+
+
+def test_rebalance_live(run):
+    """Grow bolt parallelism mid-run; all tuples still delivered + acked."""
+    CaptureBolt.seen = None
+
+    async def go():
+        cluster = AsyncLocalCluster()
+        b = TopologyBuilder()
+        spout = ListSpout([f"m{i}" for i in range(30)])
+        b.set_spout("s", spout, 1)
+        b.set_bolt("c", CaptureBolt(), 1).shuffle_grouping("s")
+        rt = await cluster.submit("t", Config(), b.build())
+        await asyncio.sleep(0.05)
+        await rt.rebalance("c", 4)
+        assert rt.parallelism_of("c") == 4
+        assert await settle(rt, "s", 30)
+        acked = list(rt.spout_execs["s"][0].spout.acked)
+        await cluster.shutdown()
+        return acked
+
+    acked = run(go())
+    assert len(acked) == 30
+    assert len(CaptureBolt.seen) == 30
+
+
+def test_sync_localcluster_facade():
+    CaptureBolt.seen = None
+    with LocalCluster() as cluster:
+        b = TopologyBuilder()
+        b.set_spout("s", ListSpout(["1", "2"]), 1)
+        b.set_bolt("c", CaptureBolt(), 1).shuffle_grouping("s")
+        cluster.submit_topology("t", Config(), b.build())
+        import time
+
+        for _ in range(500):
+            snap = cluster.metrics("t")
+            if snap.get("s", {}).get("tree_acked", 0) >= 2:
+                break
+            time.sleep(0.01)
+        snap = cluster.metrics("t")
+        assert snap["s"]["emitted"] == 2
+        cluster.kill_topology("t")
+    assert sorted(m for _, m in CaptureBolt.seen) == ["1", "2"]

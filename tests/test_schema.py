@@ -1,0 +1,77 @@
+"""Wire-contract tests: the {"instances": ...}/{"predictions": ...} JSON API
+(reference README.md:22-34, InstObj.java, PredObj.java)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from storm_tpu.api.schema import (
+    DeadLetter,
+    Instances,
+    SchemaError,
+    decode_instances,
+    decode_predictions,
+    encode_predictions,
+)
+
+
+def test_decode_mnist_shape():
+    # Reference input: 4-D NHWC batch (README.md:22-27).
+    x = np.zeros((2, 28, 28, 1), dtype=np.float32)
+    payload = json.dumps({"instances": x.tolist()})
+    inst = decode_instances(payload)
+    assert inst.data.shape == (2, 28, 28, 1)
+    assert inst.data.dtype == np.float32
+    assert inst.batch_size == 2
+
+
+def test_decode_values_roundtrip():
+    x = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4) / 7.0
+    inst = decode_instances(json.dumps({"instances": x.tolist()}))
+    np.testing.assert_allclose(inst.data, x, rtol=1e-6)
+
+
+def test_decode_bytes_payload():
+    payload = json.dumps({"instances": [[1.0, 2.0]]}).encode("utf-8")
+    assert decode_instances(payload).data.shape == (1, 2)
+
+
+def test_decode_rejects_bad_json():
+    with pytest.raises(SchemaError):
+        decode_instances("{not json")
+
+
+def test_decode_rejects_missing_key():
+    with pytest.raises(SchemaError):
+        decode_instances('{"wrong": []}')
+
+
+def test_decode_rejects_ragged():
+    with pytest.raises(SchemaError):
+        decode_instances('{"instances": [[1,2],[3]]}')
+
+
+def test_decode_rejects_scalar_and_empty():
+    with pytest.raises(SchemaError):
+        decode_instances('{"instances": 3}')
+    with pytest.raises(SchemaError):
+        decode_instances('{"instances": []}')
+
+
+def test_encode_predictions_contract():
+    # Reference output: {"predictions": [[p0..p9]]} (README.md:29-34).
+    p = np.linspace(0, 1, 10, dtype=np.float32)[None, :]
+    payload = encode_predictions(p)
+    obj = json.loads(payload)
+    assert list(obj) == ["predictions"]
+    assert len(obj["predictions"]) == 1 and len(obj["predictions"][0]) == 10
+    back = decode_predictions(payload)
+    np.testing.assert_allclose(back.data, p, atol=1e-6)
+
+
+def test_dead_letter_serializes():
+    dl = DeadLetter(payload="{bad", error="parse failed")
+    obj = json.loads(dl.to_json())
+    assert obj["stage"] == "decode"
+    assert "parse failed" in obj["error"]
