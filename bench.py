@@ -1,0 +1,213 @@
+"""End-to-end streaming benchmark.
+
+Drives the full framework path — broker JSON in -> spout -> micro-batched
+TPU inference -> sink -> broker JSON out — and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Headline config (BASELINE.md): CIFAR-10 ResNet-20, 4 inference operators.
+``vs_baseline`` is measured images/sec/chip against the north-star target
+of >=10k images/sec on a v5e-8 slice == 1250 images/sec/chip.
+
+Phases:
+1. warmup: compile bucket shapes;
+2. throughput: preload M messages, measure drain rate;
+3. latency: offered load at ~60% of measured throughput, report sink p50.
+
+All progress goes to stderr; stdout carries only the final JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC_PER_CHIP = 10_000 / 8  # north-star v5e-8 target, per chip
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+CONFIGS = {
+    "lenet5": dict(model="lenet5", input_shape=(28, 28, 1), num_classes=10,
+                   bolts=1, max_batch=512, buckets=(64, 512), metric="mnist_lenet5"),
+    "resnet20": dict(model="resnet20", input_shape=(32, 32, 3), num_classes=10,
+                     bolts=4, max_batch=512, buckets=(64, 512), metric="cifar10_resnet20"),
+    "resnet50": dict(model="resnet50", input_shape=(224, 224, 3), num_classes=1000,
+                     bolts=4, max_batch=64, buckets=(16, 64), metric="imagenet_resnet50"),
+    "vit_b16": dict(model="vit_b16", input_shape=(224, 224, 3), num_classes=1000,
+                    bolts=4, max_batch=64, buckets=(16, 64), metric="imagenet_vit_b16"),
+}
+
+
+def build_topology(cfg, broker, batch_cfg):
+    from storm_tpu.config import Config, ModelConfig, OffsetsConfig, ShardingConfig
+    from storm_tpu.connectors import BrokerSink, BrokerSpout
+    from storm_tpu.infer import InferenceBolt
+    from storm_tpu.runtime import TopologyBuilder
+
+    run_cfg = Config()
+    run_cfg.topology.message_timeout_s = 300.0
+    model_cfg = ModelConfig(
+        name=cfg["model"],
+        dtype="bfloat16",
+        input_shape=cfg["input_shape"],
+        num_classes=cfg["num_classes"],
+    )
+    tb = TopologyBuilder()
+    tb.set_spout(
+        "kafka-spout",
+        BrokerSpout(broker, "input", OffsetsConfig(policy="earliest", max_behind=None),
+                    fetch_size=1024),
+        parallelism=2,
+    )
+    tb.set_bolt(
+        "inference-bolt",
+        InferenceBolt(model_cfg, batch_cfg, ShardingConfig(data_parallel=0)),
+        parallelism=cfg["bolts"],
+    ).shuffle_grouping("kafka-spout")
+    tb.set_bolt("kafka-bolt", BrokerSink(broker, "output", run_cfg.sink), parallelism=2)\
+        .shuffle_grouping("inference-bolt")
+    tb.set_bolt("dlq-bolt", BrokerSink(broker, "dead-letter", run_cfg.sink), parallelism=1)\
+        .shuffle_grouping("inference-bolt", stream="dead_letter")
+    return run_cfg, tb.build()
+
+
+def make_payloads(cfg, n_distinct=64, instances_per_msg=1):
+    rng = np.random.RandomState(0)
+    shape = (instances_per_msg, *cfg["input_shape"])
+    return [
+        json.dumps({"instances": rng.rand(*shape).round(4).tolist()})
+        for _ in range(n_distinct)
+    ]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="resnet20", choices=sorted(CONFIGS))
+    ap.add_argument("--messages", type=int, default=4096,
+                    help="messages for the throughput phase")
+    ap.add_argument("--instances-per-msg", type=int, default=1)
+    ap.add_argument("--latency-seconds", type=float, default=8.0)
+    ap.add_argument("--max-wait-ms", type=float, default=25.0)
+    ap.add_argument("--max-batch", type=int, default=0, help="override config max_batch")
+    ap.add_argument("--skip-latency", action="store_true")
+    args = ap.parse_args()
+    cfg = CONFIGS[args.config]
+
+    import jax
+
+    from storm_tpu.config import BatchConfig
+    from storm_tpu.connectors import MemoryBroker
+    from storm_tpu.runtime.cluster import LocalCluster
+
+    n_dev = len(jax.devices())
+    log(f"devices: {jax.devices()}")
+    payloads = make_payloads(cfg, instances_per_msg=args.instances_per_msg)
+    cluster = LocalCluster()
+
+    # ---- throughput phase: long deadline -> full MXU-sized batches -----------
+    batch_cfg = BatchConfig(
+        max_batch=args.max_batch or cfg["max_batch"],
+        max_wait_ms=max(args.max_wait_ms, 100.0),
+        buckets=cfg["buckets"],
+    )
+    broker = MemoryBroker(default_partitions=4)
+    run_cfg, topo = build_topology(cfg, broker, batch_cfg)
+    t0 = time.time()
+    cluster.submit_topology("bench-throughput", run_cfg, topo)
+    log(f"submitted + warmed up in {time.time() - t0:.1f}s")
+
+    n_msgs = args.messages
+    imgs_total = n_msgs * args.instances_per_msg
+    for i in range(n_msgs):
+        broker.produce("input", payloads[i % len(payloads)])
+    t0 = time.perf_counter()
+    last = 0
+    while True:
+        done = broker.topic_size("output") + broker.topic_size("dead-letter")
+        if done >= n_msgs:
+            break
+        now = time.perf_counter()
+        if now - t0 > 600:
+            log(f"TIMEOUT with {done}/{n_msgs} delivered")
+            break
+        if done - last >= n_msgs // 8:
+            log(f"  {done}/{n_msgs} @ {done * args.instances_per_msg / (now - t0):.0f} img/s")
+            last = done
+        time.sleep(0.05)
+    elapsed = time.perf_counter() - t0
+    throughput = imgs_total / elapsed / n_dev
+    log(f"throughput: {imgs_total} imgs in {elapsed:.2f}s -> "
+        f"{throughput:.0f} img/s/chip ({n_dev} chip(s))")
+    dead = broker.topic_size("dead-letter")
+    if dead:
+        log(f"WARNING: {dead} dead-lettered")
+    snap = cluster.metrics("bench-throughput")
+    bs = snap["inference-bolt"]["batch_size"]
+    dev = snap["inference-bolt"]["device_ms"]
+    log(f"batch size mean={bs['mean']:.0f}; device ms p50={dev['p50']:.1f}")
+    cluster.kill_topology("bench-throughput", wait_secs=2)
+
+    # ---- latency phase: short deadline, offered load below saturation --------
+    # Fresh topology + metrics registry; the jit cache is shared via
+    # shared_engine, so no recompilation happens here.
+    p50 = p99 = float("nan")
+    if not args.skip_latency:
+        lat_batch_cfg = BatchConfig(
+            max_batch=args.max_batch or cfg["max_batch"],
+            max_wait_ms=args.max_wait_ms,
+            buckets=cfg["buckets"],
+        )
+        broker2 = MemoryBroker(default_partitions=4)
+        run_cfg2, topo2 = build_topology(cfg, broker2, lat_batch_cfg)
+        cluster.submit_topology("bench-latency", run_cfg2, topo2)
+        # Offer well below saturation: the latency topology uses the short
+        # deadline (small batches), so its capacity is below the
+        # throughput-phase number.
+        rate = max(8.0, throughput * n_dev * 0.3)
+        interval = 1.0 / rate
+        log(f"latency phase: offered {rate:.0f} msg/s for {args.latency_seconds}s")
+        sent = 0
+        t0 = time.perf_counter()
+        end = t0 + args.latency_seconds
+        nxt = t0
+        while time.perf_counter() < end:
+            now = time.perf_counter()
+            while nxt <= now:
+                broker2.produce("input", payloads[sent % len(payloads)])
+                sent += 1
+                nxt += interval
+            time.sleep(min(0.002, max(0.0, nxt - time.perf_counter())))
+        while broker2.topic_size("output") < sent:
+            if time.perf_counter() - end > 60:
+                break
+            time.sleep(0.05)
+        snap = cluster.metrics("bench-latency")
+        lat = snap["kafka-bolt"]["e2e_latency_ms"]
+        p50, p99 = lat["p50"], lat["p99"]
+        log(f"e2e latency ms: p50={p50:.1f} p99={p99:.1f}")
+        cluster.kill_topology("bench-latency", wait_secs=2)
+
+    cluster.shutdown()
+
+    result = {
+        "metric": f"{cfg['metric']}_images_per_sec_per_chip",
+        "value": round(throughput, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(throughput / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+        "p50_latency_ms": round(p50, 1) if p50 == p50 else None,
+        "p99_latency_ms": round(p99, 1) if p99 == p99 else None,
+        "chips": n_dev,
+        "config": args.config,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

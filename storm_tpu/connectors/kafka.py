@@ -3,12 +3,17 @@
 The deployment environment this framework is developed in has no Kafka
 client wheel; these adapters activate when ``aiokafka`` or
 ``confluent_kafka`` is importable and otherwise raise a clear error at
-construction time. The topology-facing API is identical to the in-memory
-broker path (:class:`storm_tpu.connectors.spout.BrokerSpout` /
-:class:`storm_tpu.connectors.sink.BrokerSink`), so swapping
-``BrokerConfig.kind`` between ``memory`` and ``kafka`` is a config change,
-not a code change — unlike the reference, where broker endpoints are
-edit-the-source constants (MainTopology.java:33-34).
+construction time.
+
+Current coverage: **produce-side only** (enough for BrokerSink via a custom
+``make_producer``). The fetch/offset surface BrokerSpout needs
+(``fetch``/``latest_offset``/``committed``/``commit``) raises
+NotImplementedError until a client library is present to back it — the
+method stubs document the exact contract. The goal state (and the
+in-memory broker reality today) is that swapping ``BrokerConfig.kind``
+between ``memory`` and ``kafka`` is a config change, not a code change —
+unlike the reference, where broker endpoints are edit-the-source constants
+(MainTopology.java:33-34).
 """
 
 from __future__ import annotations
@@ -57,8 +62,26 @@ class KafkaClientBroker:
     def flush(self, timeout: float = 10.0) -> None:
         self._producer.flush(timeout)
 
-    # Fetch-side methods intentionally minimal; BrokerSpout over real Kafka
-    # should use a consumer loop — implemented when a client lib is present.
     def partitions_for(self, topic: str) -> int:
         md = self._producer.list_topics(topic, timeout=5.0)
         return max(1, len(md.topics[topic].partitions))
+
+    # ---- fetch/offset surface required by BrokerSpout (not yet backed) ------
+
+    def fetch(self, topic, partition, offset, max_records=512):
+        raise NotImplementedError(
+            "KafkaClientBroker is produce-only for now; BrokerSpout over real "
+            "Kafka needs a consumer-backed fetch"
+        )
+
+    def earliest_offset(self, topic, partition):
+        raise NotImplementedError("produce-only adapter")
+
+    def latest_offset(self, topic, partition):
+        raise NotImplementedError("produce-only adapter")
+
+    def committed(self, group, topic, partition):
+        raise NotImplementedError("produce-only adapter")
+
+    def commit(self, group, topic, partition, offset):
+        raise NotImplementedError("produce-only adapter")

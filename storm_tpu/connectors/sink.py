@@ -185,5 +185,10 @@ class BrokerSink(Bolt):
             self._latency.observe((time.perf_counter() - t.root_ts) * 1e3)
         self.collector.ack(t)
 
+    async def flush(self) -> None:
+        """Settle in-flight async sends before the producer closes."""
+        while self._inflight:
+            await asyncio.gather(*list(self._inflight), return_exceptions=True)
+
     def cleanup(self) -> None:
         self.producer.close()

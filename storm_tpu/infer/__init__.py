@@ -1,0 +1,4 @@
+from storm_tpu.infer.engine import InferenceEngine, shared_engine
+from storm_tpu.infer.operator import InferenceBolt
+
+__all__ = ["InferenceEngine", "shared_engine", "InferenceBolt"]

@@ -1,0 +1,167 @@
+"""The TPU inference engine: jit-compiled model apply over a device mesh.
+
+Replaces the reference's inference engine layer (layer 4, SURVEY.md §1):
+``SavedModelBundle.load`` + per-tuple ``session.run`` over JNI
+(InferenceBolt.java:57, :80-86) becomes a jit-compiled JAX function over a
+``Mesh`` with the batch axis sharded across ``data`` and params replicated
+(or TP-sharded across ``model``). One engine is shared by all inference
+operator tasks on a host — the mesh, not operator replication, is the
+parallelism (the reference instead loaded one full model copy per bolt).
+
+Outputs are softmax probabilities, matching the reference's fetch of
+``"output/Softmax:0"`` (InferenceBolt.java:84).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+from storm_tpu.models.registry import ModelDef, build_model, load_or_init
+from storm_tpu.parallel.mesh import make_mesh
+from storm_tpu.parallel.sharding import batch_sharding, replicated
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        sharding_cfg: Optional[ShardingConfig] = None,
+        batch_cfg: Optional[BatchConfig] = None,
+        mesh=None,
+        softmax: bool = True,
+    ) -> None:
+        self.model_cfg = model_cfg
+        self.sharding_cfg = sharding_cfg or ShardingConfig()
+        self.batch_cfg = batch_cfg or BatchConfig()
+        self.model: ModelDef = build_model(
+            model_cfg.name,
+            num_classes=model_cfg.num_classes,
+            input_shape=tuple(model_cfg.input_shape),
+        )
+        self.dtype = jnp.dtype(model_cfg.dtype)
+        self.mesh = mesh if mesh is not None else make_mesh(
+            self.sharding_cfg.data_parallel,
+            self.sharding_cfg.tensor_parallel,
+            self.sharding_cfg.axis_names,
+        )
+        self.data_axis = self.sharding_cfg.axis_names[0]
+        self._lock = threading.Lock()
+
+        params, state = load_or_init(self.model, model_cfg.checkpoint, model_cfg.seed)
+        cast = lambda t: jax.tree.map(
+            lambda a: a.astype(self.dtype) if a.dtype == jnp.float32 else a, t
+        )
+        # BN statistics stay f32 (cast only f32 leaves to compute dtype would
+        # nuke them too) — so cast params only; state is small and stays f32.
+        self.params = jax.device_put(cast(params), replicated(self.mesh))
+        self.state = jax.device_put(state, replicated(self.mesh))
+
+        apply = self.model.apply
+        x_shard = batch_sharding(self.mesh, self.data_axis)
+
+        def fwd(params, state, x):
+            logits, _ = apply(params, state, x, train=False)
+            logits = logits.astype(jnp.float32)
+            return jax.nn.softmax(logits, axis=-1) if softmax else logits
+
+        self._fwd = jax.jit(
+            fwd,
+            in_shardings=(replicated(self.mesh), replicated(self.mesh), x_shard),
+            out_shardings=x_shard,
+        )
+        self._x_sharding = x_shard
+        self.compiled_batches: set = set()
+
+    # ---- shape management ----------------------------------------------------
+
+    @property
+    def input_shape(self) -> Tuple[int, ...]:
+        return tuple(self.model.input_shape)
+
+    def pad_batch(self, n: int) -> int:
+        """Pad a batch size to the compiled-bucket grid, respecting the mesh:
+        every bucket must divide evenly across the data axis. Oversized
+        batches (a single record larger than max_batch) round up to the
+        next dp multiple instead of crashing — they just compile one extra
+        shape."""
+        dp = self.mesh.shape[self.data_axis]
+        b = self.batch_cfg.bucket_for(n)
+        if b < n:
+            b = n
+        return max(dp, ((b + dp - 1) // dp) * dp)
+
+    def warmup(self, buckets: Optional[Tuple[int, ...]] = None) -> None:
+        """Pre-compile the bucket shapes so first traffic doesn't hit XLA
+        compile latency (the deadline batcher depends on stable latencies)."""
+        for b in buckets or self.batch_cfg.buckets:
+            n = self.pad_batch(b)
+            if n in self.compiled_batches:
+                continue
+            x = np.zeros((n, *self.input_shape), self.dtype)
+            np.asarray(self.predict(x))
+
+    # ---- the hot call --------------------------------------------------------
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Blocking batched forward: pad -> device -> fwd -> host.
+
+        Called from a worker thread (asyncio.to_thread) so the event loop
+        keeps batching while the device computes. Thread-safe: jit dispatch
+        is serialized under a lock; XLA executions themselves overlap via
+        the device queue.
+        """
+        n = x.shape[0]
+        padded = self.pad_batch(n)
+        if padded != n:
+            x = np.concatenate([x, np.zeros((padded - n, *x.shape[1:]), x.dtype)])
+        # Cast on the HOST (ml_dtypes gives numpy a bfloat16) so the
+        # host->device transfer ships half the bytes — the tunnel/PCIe link
+        # is the streaming bottleneck, not the cast.
+        if x.dtype != self.dtype:
+            x = x.astype(self.dtype)
+        with self._lock:
+            xd = jax.device_put(x, self._x_sharding)
+            out = self._fwd(self.params, self.state, xd)
+        self.compiled_batches.add(padded)
+        return np.asarray(out)[:n]
+
+
+# ---- engine sharing across operator tasks ------------------------------------
+
+_ENGINES: Dict[tuple, InferenceEngine] = {}
+_ENGINES_LOCK = threading.Lock()
+
+
+def shared_engine(
+    model_cfg: ModelConfig,
+    sharding_cfg: Optional[ShardingConfig] = None,
+    batch_cfg: Optional[BatchConfig] = None,
+) -> InferenceEngine:
+    """One engine per (model, dtype, shape, mesh) per process: operator tasks
+    share params in HBM instead of the reference's per-replica model copies
+    (InferenceBolt.java:57-58 + per-bolt Model boxes in the diagram)."""
+    key = (
+        model_cfg.name,
+        model_cfg.dtype,
+        tuple(model_cfg.input_shape),
+        model_cfg.num_classes,
+        model_cfg.checkpoint,
+        model_cfg.seed,
+        (sharding_cfg.data_parallel, sharding_cfg.tensor_parallel)
+        if sharding_cfg
+        else None,
+        # Batch policy is part of the identity: pad_batch/warmup read the
+        # engine's buckets, so two operators with different batching must
+        # not share one engine.
+        (batch_cfg.max_batch, tuple(batch_cfg.buckets)) if batch_cfg else None,
+    )
+    with _ENGINES_LOCK:
+        if key not in _ENGINES:
+            _ENGINES[key] = InferenceEngine(model_cfg, sharding_cfg, batch_cfg)
+        return _ENGINES[key]

@@ -1,0 +1,178 @@
+"""The inference operator — the heart of the system (reference
+InferenceBolt.java, SURVEY.md §3.3).
+
+Per-tuple flow, redesigned for the async device boundary:
+
+1. decode the ``{"instances": ...}`` payload (native C++ parser when built;
+   the reference's Jackson parse, InferenceBolt.java:76);
+2. validate against the model's input shape — a mismatch or parse failure
+   emits a :class:`DeadLetter` on the ``dead_letter`` stream and acks
+   (the reference emitted ``null`` and acked, :92-99 — poison input should
+   never wedge the stream, but it should also never masquerade as output);
+3. feed the micro-batcher; a full batch (or deadline flush) dispatches to
+   the shared :class:`InferenceEngine` on a worker thread — the event loop
+   keeps consuming while the TPU computes (the reference blocked its
+   executor thread in ``session.run`` at batch 1);
+4. when the batch returns, emit one ``{"predictions": ...}`` tuple per
+   input record (anchored) and ack — acks are *deferred* until the device
+   round-trip completes, preserving at-least-once across the async boundary
+   (SURVEY.md §7 "Hard parts").
+
+Failures inside the device call fail every tuple in the batch -> spout
+replay (the reference swallowed inference errors)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional, Set
+
+from storm_tpu.api.schema import DeadLetter, SchemaError, decode_instances, encode_predictions
+from storm_tpu.config import BatchConfig, Config, ModelConfig, ShardingConfig
+from storm_tpu.infer.batcher import Batch, MicroBatcher
+from storm_tpu.infer.engine import InferenceEngine, shared_engine
+from storm_tpu.runtime.base import Bolt, OutputCollector, TopologyContext
+from storm_tpu.runtime.tuples import Tuple, Values
+
+
+class InferenceBolt(Bolt):
+    def __init__(
+        self,
+        model: Optional[ModelConfig] = None,
+        batch: Optional[BatchConfig] = None,
+        sharding: Optional[ShardingConfig] = None,
+        engine: Optional[InferenceEngine] = None,
+        warmup: bool = True,
+    ) -> None:
+        self.model_cfg = model or ModelConfig()
+        self.batch_cfg = batch or BatchConfig()
+        self.sharding_cfg = sharding or ShardingConfig()
+        self._engine = engine
+        self._warmup = warmup
+
+    def clone(self) -> "InferenceBolt":
+        return InferenceBolt(
+            self.model_cfg, self.batch_cfg, self.sharding_cfg, self._engine, self._warmup
+        )
+
+    def declare_output_fields(self):
+        return {"default": ("message",), "dead_letter": ("message",)}
+
+    def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
+        super().prepare(context, collector)
+        # Shared across operator tasks: params live once in HBM; the mesh is
+        # the parallelism (vs. the reference's per-bolt model replica).
+        self.engine = self._engine or shared_engine(
+            self.model_cfg, self.sharding_cfg, self.batch_cfg
+        )
+        if self._warmup:
+            self.engine.warmup()
+        self.batcher = MicroBatcher(self.batch_cfg)
+        self._flush_task: Optional[asyncio.Task] = None
+        self._inflight: Set[asyncio.Task] = set()
+        # At most 2 batches in flight: one computing on device while the
+        # next accumulates/pads — more just adds latency, not throughput.
+        self._dispatch_sem = asyncio.Semaphore(2)
+        m = context.metrics
+        cid = context.component_id
+        self._m_batch = m.histogram(cid, "batch_size")
+        self._m_device_ms = m.histogram(cid, "device_ms")
+        self._m_dead = m.counter(cid, "dead_lettered")
+        self._m_infer = m.counter(cid, "instances_inferred")
+
+    # ---- ingest --------------------------------------------------------------
+
+    async def execute(self, t: Tuple) -> None:
+        payload = t.get("message")
+        try:
+            inst = decode_instances(payload, ts=t.root_ts)
+            if tuple(inst.data.shape[1:]) != self.engine.input_shape:
+                raise SchemaError(
+                    f"instance shape {tuple(inst.data.shape[1:])} != model "
+                    f"input {self.engine.input_shape}"
+                )
+        except SchemaError as e:
+            await self._dead_letter(t, payload, str(e))
+            return
+        batch = self.batcher.add(t, inst.data, ts=t.root_ts or None)
+        if batch is not None:
+            await self._dispatch(batch)
+        if len(self.batcher) and (self._flush_task is None or self._flush_task.done()):
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._deadline_flush()
+            )
+
+    async def _dead_letter(self, t: Tuple, payload: str, error: str) -> None:
+        """Poison input: route to the dead-letter stream and ack (replaying
+        a parse failure can never succeed; the reference's emit-null-and-ack
+        at InferenceBolt.java:92-99 is the anti-pattern this replaces)."""
+        self._m_dead.inc()
+        dl = DeadLetter(payload=str(payload), error=error)
+        await self.collector.emit(
+            Values([dl.to_json()]), stream="dead_letter", anchors=[t]
+        )
+        self.collector.ack(t)
+
+    # ---- batching / dispatch -------------------------------------------------
+
+    async def _deadline_flush(self) -> None:
+        """Runs while records are pending; never cancelled mid-dispatch (a
+        cancel between take and dispatch would silently drop the batch), it
+        just exits when the batcher drains."""
+        while True:
+            oldest = self.batcher.oldest_ts
+            if oldest is None:
+                return
+            wait_s = self.batch_cfg.max_wait_ms / 1e3 - (time.perf_counter() - oldest)
+            if wait_s > 0:
+                await asyncio.sleep(wait_s)
+            batch = self.batcher.take_if_due()
+            if batch is not None:
+                await self._dispatch(batch)
+
+    async def _dispatch(self, batch: Batch) -> None:
+        await self._dispatch_sem.acquire()
+        task = asyncio.get_running_loop().create_task(self._run_batch(batch))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    async def _run_batch(self, batch: Batch) -> None:
+        try:
+            x = batch.stack()
+            t0 = time.perf_counter()
+            # Worker thread: the loop keeps batching while the TPU computes.
+            out = await asyncio.to_thread(self.engine.predict, x)
+            self._m_device_ms.observe((time.perf_counter() - t0) * 1e3)
+            self._m_batch.observe(batch.size)
+            self._m_infer.inc(batch.size)
+            for tup, preds in batch.split(out):
+                await self.collector.emit(
+                    Values([encode_predictions(preds)]), anchors=[tup]
+                )
+                self.collector.ack(tup)
+        except Exception as e:
+            # Device/compile failure: fail every tuple in the batch -> replay.
+            self.collector.report_error(e)
+            for item in batch.items:
+                self.collector.fail(item.payload)
+        finally:
+            self._dispatch_sem.release()
+
+    async def tick(self) -> None:
+        batch = self.batcher.take_if_due()
+        if batch is not None:
+            await self._dispatch(batch)
+
+    async def flush(self) -> None:
+        """Drain: dispatch whatever is pending and wait for in-flight
+        batches, so a graceful stop never strands undecoded acks."""
+        batch = self.batcher.take_all()
+        if batch is not None:
+            await self._dispatch(batch)
+        while self._inflight:
+            await asyncio.gather(*list(self._inflight), return_exceptions=True)
+
+    def cleanup(self) -> None:
+        if self._flush_task is not None and not self._flush_task.done():
+            self._flush_task.cancel()
+        self._flush_task = None

@@ -1,0 +1,3 @@
+from storm_tpu.models.registry import ModelDef, build_model, registry_names
+
+__all__ = ["ModelDef", "build_model", "registry_names"]

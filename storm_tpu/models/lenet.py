@@ -1,0 +1,44 @@
+"""LeNet-5 for MNIST — the repo-default config (BASELINE.json config 1,
+matching the reference's MNIST workload, reference README.md:16-17).
+
+Classic architecture: conv6@5x5 -> pool -> conv16@5x5 -> pool ->
+fc120 -> fc84 -> fc<classes>. Stateless (no BN)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from storm_tpu.models.registry import ModelDef, register
+from storm_tpu.ops import layers as L
+
+
+@register("lenet5")
+def build(num_classes: int = 10, input_shape: tuple = (28, 28, 1)) -> ModelDef:
+    h, w, c = input_shape
+    # Spatial size after two VALID 2x2 pools with SAME convs.
+    fh, fw = h // 4, w // 4
+    flat = fh * fw * 16
+
+    def init(rng):
+        ks = jax.random.split(rng, 5)
+        params = {
+            "c1": L.conv_init(ks[0], 5, 5, c, 6),
+            "c2": L.conv_init(ks[1], 5, 5, 6, 16),
+            "f1": L.dense_init(ks[2], flat, 120),
+            "f2": L.dense_init(ks[3], 120, 84),
+            "out": L.dense_init(ks[4], 84, num_classes),
+        }
+        return params, {}
+
+    def apply(params, state, x, train: bool = False):
+        x = L.relu(L.conv2d(params["c1"], x, padding="SAME"))
+        x = L.max_pool(x)
+        x = L.relu(L.conv2d(params["c2"], x, padding="SAME"))
+        x = L.max_pool(x)
+        x = x.reshape(x.shape[0], -1)
+        x = L.relu(L.dense(params["f1"], x))
+        x = L.relu(L.dense(params["f2"], x))
+        return L.dense(params["out"], x), state
+
+    return ModelDef("lenet5", input_shape, num_classes, init, apply)

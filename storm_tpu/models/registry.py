@@ -1,0 +1,86 @@
+"""Model registry: name -> builder.
+
+Replaces the reference's model identity mechanism — a hard-coded SavedModel
+blob shipped inside the application jar with hard-coded tensor names
+(InferenceBolt.java:49-58, :83-84) — with named builders producing
+transparent JAX param pytrees. Checkpoints load via orbax from
+``ModelConfig.checkpoint``; absent a checkpoint, params are seeded
+deterministically from ``ModelConfig.seed``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelDef:
+    """A model family instance: pure init/apply pair + metadata.
+
+    ``apply(params, state, x, train=False) -> (logits, new_state)`` where
+    ``state`` carries running statistics (BatchNorm) and is empty for
+    stateless models.
+    """
+
+    name: str
+    input_shape: tuple  # per-instance (H, W, C)
+    num_classes: int
+    init: Callable[[jax.Array], Tuple[Any, Any]]
+    apply: Callable[..., Tuple[jnp.ndarray, Any]]
+    flagship: bool = False
+
+
+_BUILDERS: Dict[str, Callable[..., ModelDef]] = {}
+
+
+def register(name: str) -> Callable:
+    def deco(fn: Callable[..., ModelDef]) -> Callable[..., ModelDef]:
+        _BUILDERS[name] = fn
+        return fn
+
+    return deco
+
+
+def _load_builtin() -> None:
+    # Import model modules lazily so registration happens on demand.
+    from storm_tpu.models import lenet, resnet, vit  # noqa: F401
+
+
+def registry_names() -> list:
+    _load_builtin()
+    return sorted(_BUILDERS)
+
+
+def build_model(name: str, **kwargs) -> ModelDef:
+    _load_builtin()
+    if name not in _BUILDERS:
+        raise KeyError(f"unknown model {name!r}; available: {registry_names()}")
+    return _BUILDERS[name](**kwargs)
+
+
+def init_params(model: ModelDef, seed: int = 0):
+    return model.init(jax.random.PRNGKey(seed))
+
+
+def load_or_init(model: ModelDef, checkpoint: Optional[str], seed: int = 0):
+    """Load params/state from an orbax checkpoint dir, or initialize."""
+    params, state = init_params(model, seed)
+    if checkpoint:
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            restored = ckptr.restore(checkpoint, {"params": params, "state": state})
+        params, state = restored["params"], restored["state"]
+    return params, state
+
+
+def save_checkpoint(path: str, params, state) -> None:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, {"params": params, "state": state})
+        ckptr.wait_until_finished()

@@ -1,0 +1,169 @@
+"""ResNets: ResNet-20 (CIFAR-10) and ResNet-50 (ImageNet) —
+BASELINE.json configs 2 and 3 (the reference's CIFAR workload,
+reference README.md:17-18, scaled up).
+
+Functional param/state pytrees; BatchNorm running stats thread through
+``state`` (train mode returns updated stats, inference uses them frozen).
+NHWC + SAME padding; matmul-heavy blocks map onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from storm_tpu.models.registry import ModelDef, register
+from storm_tpu.ops import layers as L
+
+
+def _conv_bn_init(rng, kh, kw, cin, cout):
+    p_conv = L.conv_init(rng, kh, kw, cin, cout, bias=False)
+    p_bn, s_bn = L.batchnorm_init(cout)
+    return {"conv": p_conv, "bn": p_bn}, {"bn": s_bn}
+
+
+def _conv_bn(p, s, x, stride=1, train=False, act=True):
+    x = L.conv2d(p["conv"], x, stride=stride, padding="SAME")
+    x, new_bn = L.batchnorm(p["bn"], s["bn"], x, train=train)
+    if act:
+        x = L.relu(x)
+    return x, {"bn": new_bn}
+
+
+# ---- ResNet-20 (CIFAR): 3 stages x 3 basic blocks, widths 16/32/64 -----------
+
+
+def _basic_block_init(rng, cin, cout):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    p1, s1 = _conv_bn_init(k1, 3, 3, cin, cout)
+    p2, s2 = _conv_bn_init(k2, 3, 3, cout, cout)
+    p = {"a": p1, "b": p2}
+    s = {"a": s1, "b": s2}
+    if cin != cout:
+        pd, sd = _conv_bn_init(k3, 1, 1, cin, cout)
+        p["down"] = pd
+        s["down"] = sd
+    return p, s
+
+
+def _basic_block(p, s, x, stride, train):
+    idn = x
+    y, sa = _conv_bn(p["a"], s["a"], x, stride=stride, train=train)
+    y, sb = _conv_bn(p["b"], s["b"], y, train=train, act=False)
+    new_s = {"a": sa, "b": sb}
+    if "down" in p:
+        idn, sd = _conv_bn(p["down"], s["down"], x, stride=stride, train=train, act=False)
+        new_s["down"] = sd
+    return L.relu(y + idn), new_s
+
+
+@register("resnet20")
+def build_resnet20(num_classes: int = 10, input_shape: tuple = (32, 32, 3)) -> ModelDef:
+    widths = (16, 32, 64)
+    blocks_per_stage = 3
+
+    def init(rng):
+        ks = iter(jax.random.split(rng, 2 + 3 * blocks_per_stage))
+        p_stem, s_stem = _conv_bn_init(next(ks), 3, 3, input_shape[2], widths[0])
+        params = {"stem": p_stem, "stages": []}
+        state = {"stem": s_stem, "stages": []}
+        cin = widths[0]
+        for w in widths:
+            sp, ss = [], []
+            for b in range(blocks_per_stage):
+                pb, sb = _basic_block_init(next(ks), cin, w)
+                sp.append(pb)
+                ss.append(sb)
+                cin = w
+            params["stages"].append(sp)
+            state["stages"].append(ss)
+        params["head"] = L.dense_init(next(ks), widths[-1], num_classes)
+        return params, state
+
+    def apply(params, state, x, train: bool = False):
+        x, s_stem = _conv_bn(params["stem"], state["stem"], x, train=train)
+        new_state = {"stem": s_stem, "stages": []}
+        for si, (sp, ss) in enumerate(zip(params["stages"], state["stages"])):
+            new_ss = []
+            for bi, (pb, sb) in enumerate(zip(sp, ss)):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                x, nb = _basic_block(pb, sb, x, stride, train)
+                new_ss.append(nb)
+            new_state["stages"].append(new_ss)
+        x = L.global_avg_pool(x)
+        return L.dense(params["head"], x), new_state
+
+    return ModelDef("resnet20", input_shape, num_classes, init, apply)
+
+
+# ---- ResNet-50 (ImageNet): bottleneck blocks [3,4,6,3] -----------------------
+
+
+def _bottleneck_init(rng, cin, cmid, cout):
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    p1, s1 = _conv_bn_init(k1, 1, 1, cin, cmid)
+    p2, s2 = _conv_bn_init(k2, 3, 3, cmid, cmid)
+    p3, s3 = _conv_bn_init(k3, 1, 1, cmid, cout)
+    p = {"a": p1, "b": p2, "c": p3}
+    s = {"a": s1, "b": s2, "c": s3}
+    if cin != cout:
+        pd, sd = _conv_bn_init(k4, 1, 1, cin, cout)
+        p["down"] = pd
+        s["down"] = sd
+    return p, s
+
+
+def _bottleneck(p, s, x, stride, train):
+    idn = x
+    y, sa = _conv_bn(p["a"], s["a"], x, train=train)
+    y, sb = _conv_bn(p["b"], s["b"], y, stride=stride, train=train)
+    y, sc = _conv_bn(p["c"], s["c"], y, train=train, act=False)
+    new_s = {"a": sa, "b": sb, "c": sc}
+    if "down" in p:
+        idn, sd = _conv_bn(p["down"], s["down"], x, stride=stride, train=train, act=False)
+        new_s["down"] = sd
+    return L.relu(y + idn), new_s
+
+
+@register("resnet50")
+def build_resnet50(num_classes: int = 1000, input_shape: tuple = (224, 224, 3)) -> ModelDef:
+    stage_blocks = (3, 4, 6, 3)
+    mids = (64, 128, 256, 512)
+
+    def init(rng):
+        n_blocks = sum(stage_blocks)
+        ks = iter(jax.random.split(rng, 2 + n_blocks))
+        p_stem, s_stem = _conv_bn_init(next(ks), 7, 7, input_shape[2], 64)
+        params = {"stem": p_stem, "stages": []}
+        state = {"stem": s_stem, "stages": []}
+        cin = 64
+        for mid, nb in zip(mids, stage_blocks):
+            cout = mid * 4
+            sp, ss = [], []
+            for b in range(nb):
+                pb, sb = _bottleneck_init(next(ks), cin, mid, cout)
+                sp.append(pb)
+                ss.append(sb)
+                cin = cout
+            params["stages"].append(sp)
+            state["stages"].append(ss)
+        params["head"] = L.dense_init(next(ks), mids[-1] * 4, num_classes)
+        return params, state
+
+    def apply(params, state, x, train: bool = False):
+        x, s_stem = _conv_bn(params["stem"], state["stem"], x, stride=2, train=train)
+        x = L.max_pool(x, window=3, stride=2) if x.shape[1] >= 3 else x
+        new_state = {"stem": s_stem, "stages": []}
+        for si, (sp, ss) in enumerate(zip(params["stages"], state["stages"])):
+            new_ss = []
+            for bi, (pb, sb) in enumerate(zip(sp, ss)):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                x, nb = _bottleneck(pb, sb, x, stride, train)
+                new_ss.append(nb)
+            new_state["stages"].append(new_ss)
+        x = L.global_avg_pool(x)
+        return L.dense(params["head"], x), new_state
+
+    return ModelDef("resnet50", input_shape, num_classes, init, apply)

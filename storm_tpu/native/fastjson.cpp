@@ -1,0 +1,262 @@
+// Fast {"instances": [[[[...]]]]} JSON parser -> contiguous float32 buffer.
+//
+// The per-tuple hot path of the reference is a Jackson JSON parse plus JNI
+// float-array copies (InferenceBolt.java:76-86). This is the TPU-native
+// equivalent: one pass over the payload bytes, floats decoded with
+// std::from_chars straight into a single contiguous buffer that NumPy wraps
+// zero-copy on the Python side (storm_tpu/native/__init__.py), ready for a
+// single host->device transfer.
+//
+// Contract (mirrors storm_tpu.api.schema.decode_instances):
+//   - top-level object must contain an "instances" key; other keys are
+//     skipped structurally;
+//   - value must be a rectangular nested array, max rank 8; raggedness,
+//     non-numeric leaves, empty dims and malformed JSON are errors;
+//   - returns a malloc'd float buffer (caller frees via stpu_free) and the
+//     shape/rank via out-params; on error returns nullptr with a
+//     thread-local message in *err_out.
+//
+// Build: make -C storm_tpu/native   (g++ -O3 -shared -fPIC)
+
+#include <cctype>
+#include <charconv>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxRank = 8;
+
+thread_local std::string g_err;
+
+struct Parser {
+  const char* p;
+  const char* end;
+  std::vector<float> out;
+  int64_t shape[kMaxRank];
+  int rank = -1;  // set on first full descent
+
+  explicit Parser(const char* buf, size_t len) : p(buf), end(buf + len) {
+    for (int64_t& s : shape) s = -1;
+    out.reserve(1024);
+  }
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  }
+
+  bool fail(const std::string& msg) {
+    g_err = msg;
+    return false;
+  }
+
+  bool expect(char c) {
+    skip_ws();
+    if (p >= end || *p != c) return fail(std::string("expected '") + c + "'");
+    ++p;
+    return true;
+  }
+
+  // Parse a JSON string (only used for keys; escapes are skipped, not decoded).
+  bool parse_string(std::string* s) {
+    skip_ws();
+    if (p >= end || *p != '"') return fail("expected string");
+    ++p;
+    const char* start = p;
+    while (p < end) {
+      if (*p == '\\') {
+        p += 2;
+        continue;
+      }
+      if (*p == '"') {
+        if (s) s->assign(start, p - start);
+        ++p;
+        return true;
+      }
+      ++p;
+    }
+    return fail("unterminated string");
+  }
+
+  // Structurally skip any JSON value (for non-"instances" keys).
+  bool skip_value() {
+    skip_ws();
+    if (p >= end) return fail("unexpected end of input");
+    char c = *p;
+    if (c == '"') return parse_string(nullptr);
+    if (c == '{' || c == '[') {
+      char open = c, close = (c == '{') ? '}' : ']';
+      int depth = 0;
+      while (p < end) {
+        if (*p == '"') {
+          if (!parse_string(nullptr)) return false;
+          continue;
+        }
+        if (*p == open) ++depth;
+        if (*p == close && --depth == 0) {
+          ++p;
+          return true;
+        }
+        ++p;
+      }
+      return fail("unterminated container");
+    }
+    // number / literal: consume until delimiter
+    while (p < end && *p != ',' && *p != '}' && *p != ']' &&
+           !std::isspace(static_cast<unsigned char>(*p)))
+      ++p;
+    return true;
+  }
+
+  bool parse_number() {
+    skip_ws();
+    double v;
+    auto res = std::from_chars(p, end, v);
+    if (res.ec != std::errc()) return fail("instances contains a non-numeric leaf");
+    p = res.ptr;
+    out.push_back(static_cast<float>(v));
+    return true;
+  }
+
+  // Parse the nested array at `depth`; returns element count via *count.
+  bool parse_array(int depth, int64_t* count) {
+    if (depth >= kMaxRank) return fail("instances exceeds max rank 8");
+    if (!expect('[')) return false;
+    skip_ws();
+    if (p < end && *p == ']') {
+      ++p;
+      return fail("instances has an empty dimension");
+    }
+    int64_t n = 0;
+    while (true) {
+      skip_ws();
+      if (p >= end) return fail("unterminated array");
+      if (*p == '[') {
+        int64_t sub = 0;
+        if (!parse_array(depth + 1, &sub)) return false;
+      } else {
+        if (rank >= 0 && depth != rank - 1)
+          return fail("instances is ragged (mixed nesting depth)");
+        if (!parse_number()) return false;
+      }
+      ++n;
+      skip_ws();
+      if (p < end && *p == ',') {
+        ++p;
+        continue;
+      }
+      if (p < end && *p == ']') {
+        ++p;
+        break;
+      }
+      return fail("expected ',' or ']' in array");
+    }
+    if (shape[depth] == -1) {
+      shape[depth] = n;
+    } else if (shape[depth] != n) {
+      return fail("instances is ragged (inconsistent lengths)");
+    }
+    *count = n;
+    return true;
+  }
+
+  bool parse_instances_value() {
+    skip_ws();
+    if (p >= end || *p != '[')
+      return fail("\"instances\" must be a nested array");
+    // First, probe nesting depth to fix the rank (scan leading '[').
+    const char* q = p;
+    int depth = 0;
+    while (q < end) {
+      if (*q == '[') {
+        ++depth;
+        ++q;
+        continue;
+      }
+      if (std::isspace(static_cast<unsigned char>(*q))) {
+        ++q;
+        continue;
+      }
+      break;
+    }
+    if (depth == 0 || depth > kMaxRank) return fail("bad instances nesting");
+    rank = depth;
+    int64_t n = 0;
+    return parse_array(0, &n);
+  }
+
+  bool parse_document() {
+    skip_ws();
+    if (!expect('{')) return fail("payload is not a JSON object");
+    bool found = false;
+    skip_ws();
+    if (p < end && *p == '}') return fail("payload missing \"instances\" key");
+    while (true) {
+      std::string key;
+      if (!parse_string(&key)) return false;
+      if (!expect(':')) return false;
+      if (key == "instances") {
+        if (!parse_instances_value()) return false;
+        found = true;
+      } else {
+        if (!skip_value()) return false;
+      }
+      skip_ws();
+      if (p < end && *p == ',') {
+        ++p;
+        skip_ws();
+        continue;
+      }
+      if (p < end && *p == '}') {
+        ++p;
+        break;
+      }
+      return fail("expected ',' or '}' in object");
+    }
+    if (!found) return fail("payload missing \"instances\" key");
+    skip_ws();
+    if (p != end) return fail("trailing bytes after JSON document");
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns a malloc'd float32 buffer (or nullptr on error; *err_out then
+// points at a thread-local message). Caller frees with stpu_free.
+float* stpu_parse_instances(const char* buf, size_t len, int64_t* shape_out,
+                            int32_t* rank_out, const char** err_out) {
+  Parser parser(buf, len);
+  if (!parser.parse_document()) {
+    if (err_out) *err_out = g_err.c_str();
+    return nullptr;
+  }
+  int rank = parser.rank;
+  int64_t expected = 1;
+  for (int i = 0; i < rank; ++i) expected *= parser.shape[i];
+  if (expected != static_cast<int64_t>(parser.out.size())) {
+    g_err = "instances is ragged (element count mismatch)";
+    if (err_out) *err_out = g_err.c_str();
+    return nullptr;
+  }
+  float* result =
+      static_cast<float*>(std::malloc(parser.out.size() * sizeof(float)));
+  if (!result) {
+    g_err = "out of memory";
+    if (err_out) *err_out = g_err.c_str();
+    return nullptr;
+  }
+  std::memcpy(result, parser.out.data(), parser.out.size() * sizeof(float));
+  for (int i = 0; i < rank; ++i) shape_out[i] = parser.shape[i];
+  *rank_out = rank;
+  return result;
+}
+
+void stpu_free(void* p) { std::free(p); }
+
+}  // extern "C"

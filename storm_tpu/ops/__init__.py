@@ -1,0 +1,4 @@
+from storm_tpu.ops import layers
+from storm_tpu.ops.attention import multi_head_attention
+
+__all__ = ["layers", "multi_head_attention"]

@@ -1,0 +1,77 @@
+"""Multi-head attention with a Pallas flash-attention fast path.
+
+The reference has no attention (CNN workloads only, SURVEY.md §5.7); the
+ViT-B/16 config in BASELINE.json adds it. On TPU the score/softmax/value
+contraction runs as a fused Pallas kernel (:mod:`storm_tpu.ops.flash_attention`)
+so the (S, S) score matrix never round-trips to HBM; on CPU (tests) and for
+shapes the kernel doesn't cover, a plain jnp reference path is used — both
+paths are numerically cross-checked in tests/test_ops.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("STORM_TPU_NO_PALLAS"):
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def attention_reference(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: Optional[float] = None
+) -> jnp.ndarray:
+    """Plain softmax(q k^T / sqrt(d)) v. Shapes: (B, H, S, D)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k).astype(jnp.float32) * scale
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
+
+
+def scaled_dot_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: Optional[float] = None
+) -> jnp.ndarray:
+    """Dispatch: Pallas flash attention on TPU, reference path elsewhere."""
+    if _use_pallas():
+        from storm_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, scale=scale)
+    return attention_reference(q, k, v, scale=scale)
+
+
+def mha_init(rng, dim: int, num_heads: int, dtype=jnp.float32) -> dict:
+    from storm_tpu.ops.layers import dense_init
+
+    ks = jax.random.split(rng, 4)
+    return {
+        "q": dense_init(ks[0], dim, dim, dtype),
+        "k": dense_init(ks[1], dim, dim, dtype),
+        "v": dense_init(ks[2], dim, dim, dtype),
+        "o": dense_init(ks[3], dim, dim, dtype),
+    }
+
+
+def multi_head_attention(p: dict, x: jnp.ndarray, num_heads: int) -> jnp.ndarray:
+    """Self-attention over (B, S, C) activations."""
+    from storm_tpu.ops.layers import dense
+
+    b, s, c = x.shape
+    d = c // num_heads
+
+    def split(y):
+        return y.reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
+
+    q, k, v = split(dense(p["q"], x)), split(dense(p["k"], x)), split(dense(p["v"], x))
+    out = scaled_dot_attention(q, k, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, c)
+    return dense(p["o"], out)

@@ -1,0 +1,164 @@
+"""Functional NN layers: pure jnp/lax functions + param initializers.
+
+The compute vocabulary for the model zoo (:mod:`storm_tpu.models`), written
+TPU-first: NHWC layouts (XLA's preferred conv layout on TPU), matmul-shaped
+ops that tile onto the MXU, static shapes everywhere, and no Python control
+flow inside traced code. Replaces the reference's opaque frozen-graph blob
+(``SavedModelBundle.load``, InferenceBolt.java:57) with transparent param
+pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# ---- initializers ------------------------------------------------------------
+
+
+def he_normal(rng, shape, fan_in: int, dtype=jnp.float32):
+    return jax.random.normal(rng, shape, dtype) * np.sqrt(2.0 / fan_in)
+
+
+def lecun_normal(rng, shape, fan_in: int, dtype=jnp.float32):
+    return jax.random.normal(rng, shape, dtype) * np.sqrt(1.0 / fan_in)
+
+
+def trunc_normal(rng, shape, std: float = 0.02, dtype=jnp.float32):
+    return jax.random.truncated_normal(rng, -2.0, 2.0, shape, dtype) * std
+
+
+# ---- dense -------------------------------------------------------------------
+
+
+def dense_init(rng, in_dim: int, out_dim: int, dtype=jnp.float32) -> dict:
+    kw, _ = jax.random.split(rng)
+    return {
+        "w": lecun_normal(kw, (in_dim, out_dim), in_dim, dtype),
+        "b": jnp.zeros((out_dim,), dtype),
+    }
+
+
+def dense(p: dict, x: jnp.ndarray) -> jnp.ndarray:
+    # Accumulate matmuls in f32 on the MXU even for bf16 inputs.
+    return jnp.dot(x, p["w"], preferred_element_type=jnp.float32).astype(x.dtype) + p["b"]
+
+
+# ---- conv --------------------------------------------------------------------
+
+
+def conv_init(
+    rng, kh: int, kw: int, cin: int, cout: int, dtype=jnp.float32, bias: bool = True
+) -> dict:
+    kr, _ = jax.random.split(rng)
+    p = {"w": he_normal(kr, (kh, kw, cin, cout), kh * kw * cin, dtype)}
+    if bias:
+        p["b"] = jnp.zeros((cout,), dtype)
+    return p
+
+
+def conv2d(
+    p: dict,
+    x: jnp.ndarray,
+    stride: int | Tuple[int, int] = 1,
+    padding: str | Sequence[Tuple[int, int]] = "SAME",
+) -> jnp.ndarray:
+    """NHWC x HWIO -> NHWC convolution (MXU path)."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    out = lax.conv_general_dilated(
+        x,
+        p["w"].astype(x.dtype),
+        window_strides=stride,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if "b" in p:
+        out = out + p["b"].astype(x.dtype)
+    return out
+
+
+# ---- pooling -----------------------------------------------------------------
+
+
+def max_pool(x: jnp.ndarray, window: int = 2, stride: int = 2) -> jnp.ndarray:
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        (1, window, window, 1),
+        (1, stride, stride, 1),
+        "VALID",
+    )
+
+
+def avg_pool(x: jnp.ndarray, window: int = 2, stride: int = 2) -> jnp.ndarray:
+    s = lax.reduce_window(
+        x, 0.0, lax.add, (1, window, window, 1), (1, stride, stride, 1), "VALID"
+    )
+    return s / (window * window)
+
+
+def global_avg_pool(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(x, axis=(1, 2))
+
+
+# ---- normalization -----------------------------------------------------------
+
+
+def batchnorm_init(dim: int, dtype=jnp.float32) -> Tuple[dict, dict]:
+    """Returns (params, state): scale/bias are learned; mean/var are running
+    statistics threaded functionally (state in, state out)."""
+    params = {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+    state = {"mean": jnp.zeros((dim,), jnp.float32), "var": jnp.ones((dim,), jnp.float32)}
+    return params, state
+
+
+def batchnorm(
+    p: dict,
+    s: dict,
+    x: jnp.ndarray,
+    train: bool = False,
+    momentum: float = 0.9,
+    eps: float = 1e-5,
+) -> Tuple[jnp.ndarray, dict]:
+    """BatchNorm over all but the channel (last) axis. Returns (y, new_state)."""
+    if train:
+        axes = tuple(range(x.ndim - 1))
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        new_s = {
+            "mean": momentum * s["mean"] + (1 - momentum) * mean,
+            "var": momentum * s["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = lax.rsqrt(var + eps) * p["scale"].astype(jnp.float32)
+    y = (x.astype(jnp.float32) - mean) * inv + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype), new_s
+
+
+def layernorm_init(dim: int, dtype=jnp.float32) -> dict:
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def layernorm(p: dict, x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+# ---- activations -------------------------------------------------------------
+
+relu = jax.nn.relu
+gelu = jax.nn.gelu
+softmax = jax.nn.softmax

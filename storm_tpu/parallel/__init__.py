@@ -1,0 +1,16 @@
+from storm_tpu.parallel.mesh import make_mesh, default_mesh
+from storm_tpu.parallel.sharding import (
+    batch_sharding,
+    replicated,
+    shard_batch,
+    shard_params_tp,
+)
+
+__all__ = [
+    "make_mesh",
+    "default_mesh",
+    "batch_sharding",
+    "replicated",
+    "shard_batch",
+    "shard_params_tp",
+]

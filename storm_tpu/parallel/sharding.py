@@ -1,0 +1,89 @@
+"""Sharding helpers: NamedShardings for batch-DP and param-TP.
+
+Scaling here is declarative (`NamedSharding` + jit) rather than the
+reference's replicate-the-operator model (SURVEY.md §2.4): annotate where
+arrays live, let XLA insert ICI collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_sharding(mesh: Mesh, data_axis: str = "data") -> NamedSharding:
+    """Shard axis 0 (batch) across the data axis; everything else replicated."""
+    return NamedSharding(mesh, P(data_axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, x: jnp.ndarray, data_axis: str = "data") -> jnp.ndarray:
+    return jax.device_put(x, batch_sharding(mesh, data_axis))
+
+
+def _is_leaf_dense(path_leaf) -> bool:
+    return False
+
+
+def shard_params_tp(
+    mesh: Mesh, params: Any, model_axis: str = "model"
+) -> Any:
+    """Megatron-style tensor-parallel placement for transformer params.
+
+    Convention (matches the model zoo's param naming):
+    - attention q/k/v and mlp_in kernels: shard the OUTPUT dim (column
+      parallel) -> (P(None, model));
+    - attention o and mlp_out kernels: shard the INPUT dim (row parallel)
+      -> (P(model, None)); XLA inserts the psum on the row-parallel matmul;
+    - biases of column-parallel layers shard on their only dim; everything
+      else (norms, embeddings, heads) replicated.
+
+    With ``model`` axis of size 1 this degrades to replication, so the same
+    code path serves pure-DP and DP+TP meshes.
+    """
+
+    def spec_for(path: tuple, leaf: jnp.ndarray) -> NamedSharding:
+        keys = [getattr(k, "key", getattr(k, "idx", None)) for k in path]
+        col = any(k in ("q", "k", "v", "mlp_in") for k in keys)
+        row = any(k in ("o", "mlp_out") for k in keys)
+        last = keys[-1] if keys else None
+        if leaf.ndim == 2 and col:
+            return NamedSharding(mesh, P(None, model_axis))
+        if leaf.ndim == 2 and row:
+            return NamedSharding(mesh, P(model_axis, None))
+        if leaf.ndim == 1 and col and last == "b":
+            return NamedSharding(mesh, P(model_axis))
+        return NamedSharding(mesh, P())
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    placed = [jax.device_put(leaf, spec_for(path, leaf)) for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+def tp_param_specs(params: Any, model_axis: str = "model") -> Any:
+    """PartitionSpec pytree matching :func:`shard_params_tp` (for pjit
+    in_shardings in the train step)."""
+
+    def spec_for(path: tuple, leaf) -> P:
+        keys = [getattr(k, "key", getattr(k, "idx", None)) for k in path]
+        col = any(k in ("q", "k", "v", "mlp_in") for k in keys)
+        row = any(k in ("o", "mlp_out") for k in keys)
+        last = keys[-1] if keys else None
+        if getattr(leaf, "ndim", 0) == 2 and col:
+            return P(None, model_axis)
+        if getattr(leaf, "ndim", 0) == 2 and row:
+            return P(model_axis, None)
+        if getattr(leaf, "ndim", 0) == 1 and col and last == "b":
+            return P(model_axis)
+        return P()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec_for(path, leaf) for path, leaf in flat]
+    )

@@ -1,0 +1,95 @@
+"""Sharded training step: dp x tp over the device mesh.
+
+The reference is inference-only (models arrive as frozen graphs,
+InferenceBolt.java:57); this module closes the loop so models served by the
+framework can also be (re)trained on the same slice — and it is the
+multi-chip program exercised by ``__graft_entry__.dryrun_multichip``.
+
+Design: pure ``jax.jit`` + committed input shardings (GSPMD propagates the
+rest and inserts the ICI collectives):
+- batch axis sharded over ``data`` (dp);
+- transformer matmul params Megatron-sharded over ``model`` (tp):
+  column-parallel qkv/mlp_in, row-parallel o/mlp_out
+  (:func:`storm_tpu.parallel.sharding.shard_params_tp`);
+- activations constrained to (data, None, model) between blocks, so the
+  sequence axis stays local while hidden is tp-sharded;
+- gradients/optimizer state inherit param shardings; the dp grad psum is
+  inserted by XLA from the sharding annotations (no hand-written NCCL —
+  SURVEY.md §2.5 accelerator-collectives row).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from storm_tpu.models.registry import ModelDef
+from storm_tpu.parallel.sharding import shard_params_tp, batch_sharding, replicated
+
+
+def make_train_step(
+    model: ModelDef,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    learning_rate: float = 1e-3,
+) -> Tuple[Callable, optax.GradientTransformation]:
+    """Build a jit-compiled ``(params, opt_state, state, x, y) ->
+    (params, opt_state, state, loss)`` step. Shardings are taken from the
+    committed shardings of the inputs (GSPMD propagation)."""
+    opt = optimizer or optax.adamw(learning_rate)
+
+    def loss_fn(params, state, x, y):
+        logits, new_state = model.apply(params, state, x, train=True)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), y
+        ).mean()
+        return loss, new_state
+
+    @jax.jit
+    def train_step(params, opt_state, state, x, y):
+        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, state, x, y
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_state, loss
+
+    return train_step, opt
+
+
+def init_sharded_training(
+    model: ModelDef,
+    mesh: Mesh,
+    seed: int = 0,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    learning_rate: float = 1e-3,
+):
+    """Initialize (params, opt_state, state) placed on the mesh:
+    params tp-sharded, optimizer state following params, model state
+    replicated. Returns (train_step, params, opt_state, state)."""
+    train_step, opt = make_train_step(model, optimizer, learning_rate)
+    params, state = model.init(jax.random.PRNGKey(seed))
+    params = shard_params_tp(mesh, params)
+    state = jax.device_put(state, replicated(mesh))
+    # opt.init under jit: output shardings propagate from the sharded params.
+    opt_state = jax.jit(opt.init)(params)
+    return train_step, params, opt_state, state
+
+
+def train_one_step(
+    train_step: Callable,
+    mesh: Mesh,
+    params,
+    opt_state,
+    state,
+    x: np.ndarray,
+    y: np.ndarray,
+):
+    """Place one (x, y) batch dp-sharded and run the step."""
+    xs = jax.device_put(x, batch_sharding(mesh))
+    ys = jax.device_put(y, batch_sharding(mesh))
+    return train_step(params, opt_state, state, xs, ys)

@@ -126,7 +126,9 @@ class OutputCollector:
         n = 0
         for inbox, edge in zip(deliveries, edges):
             t = Tuple(
-                values=probe.values,
+                # Fresh list per delivery: fan-out targets must never share
+                # one mutable values object across executor instances.
+                values=list(probe.values),
                 fields=fields,
                 source_component=self.component_id,
                 source_task=self.task_index,
@@ -206,6 +208,11 @@ class Bolt(Component):
 
     async def tick(self) -> None:
         """Periodic timer callback (tick tuples, KafkaBolt.java:36)."""
+
+    async def flush(self) -> None:
+        """Drain hook: awaited by the executor after the last tuple during a
+        graceful stop, before ``cleanup``. Bolts with deferred work (pending
+        micro-batches, in-flight producer sends) settle it here."""
 
     def cleanup(self) -> None:
         """Graceful shutdown (KafkaBolt.java:175-177 closes the producer)."""

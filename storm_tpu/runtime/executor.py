@@ -103,6 +103,12 @@ class BoltExecutor:
                 await asyncio.wait_for(self._task, timeout=30.0)
             except asyncio.TimeoutError:  # pragma: no cover
                 self._task.cancel()
+            try:
+                # Settle deferred work (pending batches, in-flight sends)
+                # before cleanup closes resources under it.
+                await asyncio.wait_for(self.bolt.flush(), timeout=30.0)
+            except Exception as e:
+                log.warning("flush error in %s: %s", self.component_id, e)
         else:
             self._task.cancel()
         try:

@@ -4,12 +4,20 @@ obligation: fake/CPU backend for multi-device simulation)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# config.update, not the env var: the dev environment pins JAX_PLATFORMS to
+# the real TPU platform in a way that survives os.environ edits; tests must
+# run on the virtual 8-device CPU backend.
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", "tests require the CPU backend"
+assert len(jax.devices()) == 8, "tests require 8 virtual CPU devices"
 
 import asyncio
 

@@ -1,0 +1,131 @@
+"""Micro-batcher + engine tests (SURVEY.md §7 step 5)."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+from storm_tpu.infer.batcher import MicroBatcher
+from storm_tpu.infer.engine import InferenceEngine
+from storm_tpu.models import build_model
+from storm_tpu.models.registry import init_params
+
+
+# ---- batcher -----------------------------------------------------------------
+
+
+def _data(n):
+    return np.zeros((n, 2, 2, 1), np.float32)
+
+
+def test_batcher_fills_to_max():
+    b = MicroBatcher(BatchConfig(max_batch=4, max_wait_ms=1000))
+    assert b.add("a", _data(2)) is None
+    batch = b.add("b", _data(2))
+    assert batch is not None
+    assert batch.size == 4
+    assert len(b) == 0
+
+
+def test_batcher_deadline():
+    b = MicroBatcher(BatchConfig(max_batch=100, max_wait_ms=5))
+    t0 = time.perf_counter()
+    b.add("a", _data(1), ts=t0)
+    assert b.take_if_due(now=t0 + 0.001) is None
+    batch = b.take_if_due(now=t0 + 0.006)
+    assert batch is not None and batch.size == 1
+
+
+def test_batcher_never_overshoots_max_batch():
+    """A record that would overshoot flushes the pending batch first
+    (reachable via multi-instance records, e.g. bench --instances-per-msg 3)."""
+    b = MicroBatcher(BatchConfig(max_batch=8, max_wait_ms=1000))
+    assert b.add("a", _data(6)) is None
+    flushed = b.add("b", _data(3))  # 6+3 > 8 -> flush the 6
+    assert flushed is not None and flushed.size == 6
+    assert len(b) == 3
+    # oversized newcomer flushes the pending 3; itself waits for the deadline
+    flushed2 = b.add("c", _data(20))
+    assert flushed2 is not None and flushed2.size == 3
+    assert len(b) == 20
+    assert b.take_all().size == 20
+
+
+def test_engine_handles_oversized_batch():
+    eng = InferenceEngine(
+        ModelConfig(name="lenet5", dtype="float32", input_shape=(28, 28, 1)),
+        ShardingConfig(data_parallel=1),
+        BatchConfig(max_batch=8, buckets=(8,)),
+    )
+    out = eng.predict(np.zeros((11, 28, 28, 1), np.float32))  # > max_batch
+    assert out.shape == (11, 10)
+
+
+def test_batcher_multi_instance_records_split():
+    b = MicroBatcher(BatchConfig(max_batch=8, max_wait_ms=1000))
+    b.add("r1", np.full((3, 2), 1.0, np.float32))
+    batch = b.add("r2", np.full((5, 2), 2.0, np.float32))
+    assert batch.size == 8
+    out = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    parts = batch.split(out)
+    assert parts[0][0] == "r1" and parts[0][1].shape == (3, 4)
+    assert parts[1][0] == "r2" and parts[1][1].shape == (5, 4)
+    np.testing.assert_array_equal(parts[1][1], out[3:])
+
+
+# ---- engine ------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lenet_engine():
+    return InferenceEngine(
+        ModelConfig(name="lenet5", dtype="float32", input_shape=(28, 28, 1)),
+        ShardingConfig(data_parallel=0),  # all 8 virtual CPU devices
+        BatchConfig(max_batch=16, buckets=(8, 16)),
+    )
+
+
+def test_engine_mesh_uses_all_devices(lenet_engine):
+    assert lenet_engine.mesh.devices.size == len(jax.devices())
+
+
+def test_engine_predict_matches_direct_apply(lenet_engine):
+    model = build_model("lenet5")
+    params, state = init_params(model, seed=0)
+    x = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(3), (5, 28, 28, 1)), np.float32
+    )
+    got = lenet_engine.predict(x)
+    logits, _ = model.apply(params, state, x)
+    want = np.asarray(jax.nn.softmax(logits, -1))
+    assert got.shape == (5, 10)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    np.testing.assert_allclose(got.sum(-1), np.ones(5), atol=1e-5)
+
+
+def test_engine_pads_to_mesh_divisible(lenet_engine):
+    dp = lenet_engine.mesh.devices.size
+    padded = lenet_engine.pad_batch(1)
+    assert padded % dp == 0
+    # Result sliced back to the true batch size.
+    out = lenet_engine.predict(np.zeros((3, 28, 28, 1), np.float32))
+    assert out.shape == (3, 10)
+
+
+def test_engine_warmup_compiles_buckets(lenet_engine):
+    lenet_engine.warmup()
+    assert lenet_engine.pad_batch(8) in lenet_engine.compiled_batches
+    assert lenet_engine.pad_batch(16) in lenet_engine.compiled_batches
+
+
+def test_engine_bf16_path():
+    eng = InferenceEngine(
+        ModelConfig(name="lenet5", dtype="bfloat16", input_shape=(28, 28, 1)),
+        ShardingConfig(data_parallel=1),
+        BatchConfig(max_batch=8, buckets=(8,)),
+    )
+    out = eng.predict(np.random.randn(2, 28, 28, 1).astype(np.float32))
+    assert out.dtype == np.float32  # probabilities come back f32
+    np.testing.assert_allclose(out.sum(-1), np.ones(2), atol=1e-2)

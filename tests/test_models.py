@@ -1,0 +1,96 @@
+"""Model zoo tests: shapes, determinism, numerics on the CPU backend
+(SURVEY.md §4: fake/CPU JAX backend for tests without TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from storm_tpu.models import build_model, registry_names
+from storm_tpu.models.registry import init_params
+
+
+def _fwd(name, batch=2, **kwargs):
+    model = build_model(name, **kwargs)
+    params, state = init_params(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, *model.input_shape))
+    logits, new_state = model.apply(params, state, x, train=False)
+    return model, logits, params, state
+
+
+def test_registry_contents():
+    names = registry_names()
+    for required in ["lenet5", "resnet20", "resnet50", "vit_b16", "vit_tiny"]:
+        assert required in names
+    with pytest.raises(KeyError):
+        build_model("nope")
+
+
+def test_lenet_shapes():
+    model, logits, *_ = _fwd("lenet5")
+    assert logits.shape == (2, 10)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_lenet_deterministic_init():
+    m = build_model("lenet5")
+    p1, _ = init_params(m, seed=0)
+    p2, _ = init_params(m, seed=0)
+    assert jax.tree.all(jax.tree.map(lambda a, b: bool(jnp.all(a == b)), p1, p2))
+
+
+def test_resnet20_shapes():
+    model, logits, *_ = _fwd("resnet20")
+    assert logits.shape == (2, 10)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_resnet20_train_updates_bn_state():
+    model = build_model("resnet20")
+    params, state = init_params(model, 0)
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 32, 32, 3)) * 3 + 1
+    _, new_state = model.apply(params, state, x, train=True)
+    stem_before = state["stem"]["bn"]["mean"]
+    stem_after = new_state["stem"]["bn"]["mean"]
+    assert not bool(jnp.all(stem_before == stem_after))
+    # Inference must not mutate state.
+    _, same_state = model.apply(params, state, x, train=False)
+    assert bool(jnp.all(same_state["stem"]["bn"]["mean"] == stem_before))
+
+
+def test_resnet50_small_input():
+    # Same code path as ImageNet config, smaller spatial dims for CI speed.
+    model, logits, *_ = _fwd("resnet50", num_classes=100, input_shape=(64, 64, 3))
+    assert logits.shape == (2, 100)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_vit_tiny_shapes():
+    model, logits, *_ = _fwd("vit_tiny")
+    assert logits.shape == (2, 10)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_vit_patch_divisibility():
+    with pytest.raises(ValueError):
+        build_model("vit_tiny", input_shape=(30, 30, 3))
+
+
+def test_vit_b16_param_count():
+    """ViT-B/16 has ~86M params — structural check against the standard
+    architecture (12 layers, dim 768, heads 12, mlp 3072)."""
+    model = build_model("vit_b16")
+    params, _ = init_params(model, 0)
+    n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    assert 85e6 < n < 87e6
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from storm_tpu.models.registry import load_or_init, save_checkpoint
+
+    model = build_model("lenet5")
+    params, state = init_params(model, 0)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, params, state)
+    params2, _ = load_or_init(model, path, seed=99)
+    assert jax.tree.all(jax.tree.map(lambda a, b: bool(jnp.all(a == b)), params, params2))

@@ -1,0 +1,80 @@
+"""Native C++ parser: build (if toolchain present), parity vs Python path."""
+
+import json
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+NATIVE_DIR = Path(__file__).parent.parent / "storm_tpu" / "native"
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    r = subprocess.run(["make", "-C", str(NATIVE_DIR)], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    import storm_tpu.native as n
+
+    # force (re)load after build
+    n._load_attempted = False
+    n._lib = None
+    if not n.native_available():
+        pytest.skip("native lib failed to load")
+    return n
+
+
+def test_native_parity_with_python(native_lib):
+    from storm_tpu.api.schema import decode_instances
+
+    x = np.random.RandomState(0).rand(3, 5, 5, 2).astype(np.float32)
+    payload = json.dumps({"instances": x.tolist(), "meta": {"k": [1, "s"]}})
+    got = native_lib.parse_instances_native(payload)
+    np.testing.assert_allclose(got, x, rtol=1e-6)
+    # and through the public decode path
+    inst = decode_instances(payload)
+    np.testing.assert_allclose(inst.data, x, rtol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        '{"instances": [[1,2],[3]]}',  # ragged
+        '{"instances": [[1,2],[3,[4]]]}',  # mixed depth
+        '{"nope": 1}',
+        '{"instances": "x"}',
+        "junk",
+        '{"instances": []}',
+        '{"instances": [[1,2]] } trailing',
+    ],
+)
+def test_native_rejects_malformed(native_lib, bad):
+    from storm_tpu.api.schema import SchemaError
+
+    with pytest.raises(SchemaError):
+        native_lib.parse_instances_native(bad)
+
+
+def test_native_number_formats(native_lib):
+    payload = '{"instances": [[1, -2.5, 3e2, 0.125e-2, 1E+2, -0.0]]}'
+    got = native_lib.parse_instances_native(payload)
+    np.testing.assert_allclose(
+        got, np.array([[1, -2.5, 300, 0.00125, 100, -0.0]], np.float32), rtol=1e-6
+    )
+
+
+def test_python_fallback_when_disabled(native_lib, monkeypatch):
+    monkeypatch.setenv("STORM_TPU_NO_NATIVE", "1")
+    import storm_tpu.native as n
+
+    n._load_attempted = False
+    n._lib = None
+    assert n.parse_instances_native('{"instances": [[1]]}') is None
+    from storm_tpu.api.schema import decode_instances
+
+    assert decode_instances('{"instances": [[1.0, 2.0]]}').data.shape == (1, 2)
+    n._load_attempted = False
+    n._lib = None

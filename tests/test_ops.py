@@ -1,0 +1,82 @@
+"""Op-level numerics: layers + attention reference path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from storm_tpu.ops import layers as L
+from storm_tpu.ops.attention import attention_reference, mha_init, multi_head_attention
+
+
+def test_dense_matches_numpy():
+    rng = jax.random.PRNGKey(0)
+    p = L.dense_init(rng, 8, 4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 8))
+    got = L.dense(p, x)
+    want = np.asarray(x) @ np.asarray(p["w"]) + np.asarray(p["b"])
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_conv_identity_kernel():
+    # 1x1 identity conv leaves channels unchanged.
+    p = {"w": jnp.eye(3).reshape(1, 1, 3, 3)}
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 5, 3))
+    np.testing.assert_allclose(np.asarray(conv := L.conv2d(p, x)), np.asarray(x), atol=1e-6)
+
+
+def test_pooling():
+    x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+    mp = L.max_pool(x)
+    ap = L.avg_pool(x)
+    assert mp.shape == (1, 2, 2, 1)
+    assert float(mp[0, 0, 0, 0]) == 5.0
+    assert float(ap[0, 0, 0, 0]) == 2.5
+
+
+def test_batchnorm_train_normalizes():
+    p, s = L.batchnorm_init(4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 4)) * 5 + 3
+    y, new_s = L.batchnorm(p, s, x, train=True)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, 0)), np.zeros(4), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(jnp.std(y, 0)), np.ones(4), atol=1e-2)
+    assert not np.allclose(np.asarray(new_s["mean"]), 0)
+
+
+def test_layernorm():
+    p = L.layernorm_init(8)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8)) * 4 + 2
+    y = L.layernorm(p, x)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, -1)), np.zeros((2,)), atol=1e-5)
+
+
+def test_attention_reference_softmax_rows():
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 4, 8))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 4, 8))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 4, 8))
+    out = attention_reference(q, k, v)
+    assert out.shape == (1, 2, 4, 8)
+    # attention output is a convex combination of v rows: bounded by v range
+    assert float(jnp.max(out)) <= float(jnp.max(v)) + 1e-5
+    assert float(jnp.min(out)) >= float(jnp.min(v)) - 1e-5
+
+
+def test_mha_shapes():
+    p = mha_init(jax.random.PRNGKey(0), 32, 4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 10, 32))
+    y = multi_head_attention(p, x, 4)
+    assert y.shape == (2, 10, 32)
+
+
+def test_flash_attention_matches_reference_interpret():
+    """Pallas kernel (interpreter on CPU) vs the jnp reference path —
+    includes the ViT-B/16 shape (197 padded) and a multi-KV-chunk case."""
+    from storm_tpu.ops.flash_attention import flash_attention
+
+    for b, h, s, d in [(1, 2, 197, 64), (2, 1, 64, 32), (1, 1, 600, 64)]:
+        q, k, v = (
+            jax.random.normal(jax.random.PRNGKey(i), (b, h, s, d), jnp.float32)
+            for i in range(3)
+        )
+        want = attention_reference(q, k, v)
+        got = flash_attention(q, k, v, interpret=True, block_k=256)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
