@@ -149,9 +149,10 @@ def main() -> None:
     if dead:
         log(f"WARNING: {dead} dead-lettered")
     snap = cluster.metrics("bench-throughput")
-    bs = snap["inference-bolt"]["batch_size"]
-    dev = snap["inference-bolt"]["device_ms"]
-    log(f"batch size mean={bs['mean']:.0f}; device ms p50={dev['p50']:.1f}")
+    bs = snap["inference-bolt"]["batch_size"]["mean"]
+    dev = snap["inference-bolt"]["device_ms"]["p50"]
+    log(f"batch size mean={bs if bs is None else round(bs)}; "
+        f"device ms p50={dev if dev is None else round(dev, 1)}")
     cluster.kill_topology("bench-throughput", wait_secs=2)
 
     # ---- latency phase: short deadline, offered load below saturation --------
@@ -190,7 +191,8 @@ def main() -> None:
             time.sleep(0.05)
         snap = cluster.metrics("bench-latency")
         lat = snap["kafka-bolt"]["e2e_latency_ms"]
-        p50, p99 = lat["p50"], lat["p99"]
+        p50 = lat["p50"] if lat["p50"] is not None else float("nan")
+        p99 = lat["p99"] if lat["p99"] is not None else float("nan")
         log(f"e2e latency ms: p50={p50:.1f} p99={p99:.1f}")
         cluster.kill_topology("bench-latency", wait_secs=2)
 

@@ -32,6 +32,7 @@ from storm_tpu.config import BatchConfig, Config, ModelConfig, ShardingConfig
 from storm_tpu.infer.batcher import Batch, MicroBatcher
 from storm_tpu.infer.engine import InferenceEngine, shared_engine
 from storm_tpu.runtime.base import Bolt, OutputCollector, TopologyContext
+from storm_tpu.runtime.tracing import span
 from storm_tpu.runtime.tuples import Tuple, Values
 
 
@@ -85,7 +86,8 @@ class InferenceBolt(Bolt):
     async def execute(self, t: Tuple) -> None:
         payload = t.get("message")
         try:
-            inst = decode_instances(payload, ts=t.root_ts)
+            with span(self.context.metrics, self.context.component_id, "decode"):
+                inst = decode_instances(payload, ts=t.root_ts)
             if tuple(inst.data.shape[1:]) != self.engine.input_shape:
                 raise SchemaError(
                     f"instance shape {tuple(inst.data.shape[1:])} != model "

@@ -1,0 +1,172 @@
+"""CLI entry point.
+
+The reference's CLI is ``storm jar ... dke.model.MainTopology <name>
+<inputTopic> <outputTopic>`` with cluster endpoints hard-coded in source and
+a fixed 1-hour run window ending in a hard kill (MainTopology.java:32-42,
+:71-77). Equivalent here, minus the quirks::
+
+    python -m storm_tpu.main run <name> <input-topic> <output-topic> \
+        [--config cfg.toml] [--set section.key=value ...] [--duration SECS]
+
+    python -m storm_tpu.main serve --model resnet20 --port 50051
+
+    python -m storm_tpu.main info
+
+``run`` builds the reference topology shape (spout -> inference -> sink,
+plus a dead-letter sink) and runs as a daemon: SIGINT/SIGTERM (or
+--duration) triggers deactivate -> drain -> kill, the graceful teardown the
+reference lacked. ``serve`` starts the standalone gRPC TPU worker."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+import sys
+import time
+
+from storm_tpu.config import Config
+from storm_tpu.utils.logging import setup_logging
+
+
+def build_standard_topology(cfg: Config, broker):
+    """The reference DAG (MainTopology.java:59-63) under our runtime."""
+    from storm_tpu.connectors import BrokerSink, BrokerSpout
+    from storm_tpu.infer import InferenceBolt
+    from storm_tpu.runtime import TopologyBuilder
+
+    tb = TopologyBuilder()
+    tb.set_spout(
+        "kafka-spout",
+        BrokerSpout(broker, cfg.broker.input_topic, cfg.offsets),
+        parallelism=cfg.topology.spout_parallelism,
+    )
+    tb.set_bolt(
+        "inference-bolt",
+        InferenceBolt(cfg.model, cfg.batch, cfg.sharding),
+        parallelism=cfg.topology.inference_parallelism,
+    ).shuffle_grouping("kafka-spout")
+    tb.set_bolt(
+        "kafka-bolt",
+        BrokerSink(broker, cfg.broker.output_topic, cfg.sink),
+        parallelism=cfg.topology.sink_parallelism,
+    ).shuffle_grouping("inference-bolt")
+    tb.set_bolt(
+        "dlq-bolt",
+        BrokerSink(broker, cfg.broker.dead_letter_topic, cfg.sink),
+        parallelism=1,
+    ).shuffle_grouping("inference-bolt", stream="dead_letter")
+    return tb.build()
+
+
+def _make_broker(cfg: Config):
+    if cfg.broker.kind == "memory":
+        from storm_tpu.connectors import MemoryBroker
+
+        return MemoryBroker(default_partitions=cfg.broker.partitions)
+    if cfg.broker.kind == "kafka":
+        from storm_tpu.connectors.kafka import KafkaClientBroker
+
+        return KafkaClientBroker(cfg.broker.bootstrap)
+    raise ValueError(f"unknown broker kind {cfg.broker.kind!r}")
+
+
+def _load_config(args) -> Config:
+    cfg = Config.load(args.config) if args.config else Config()
+    if args.set:
+        cfg.apply_overrides(args.set)
+    return cfg
+
+
+async def _run_daemon(name: str, cfg: Config, duration: float) -> None:
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    broker = _make_broker(cfg)
+    topo = build_standard_topology(cfg, broker)
+    cluster = AsyncLocalCluster()
+    rt = await cluster.submit(name, cfg, topo)
+    print(f"topology {name!r} running "
+          f"(model={cfg.model.name}, broker={cfg.broker.kind})", file=sys.stderr)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    if duration > 0:
+        loop.call_later(duration, stop.set)
+    await stop.wait()
+
+    print("draining...", file=sys.stderr)
+    await rt.deactivate()
+    await rt.drain(timeout_s=30)
+    snap = rt.metrics.snapshot()
+    await cluster.kill(name, wait_secs=0)
+    print(json.dumps(snap, default=str), file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    ap = argparse.ArgumentParser(prog="storm_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    runp = sub.add_parser("run", help="run a topology daemon")
+    runp.add_argument("name")
+    runp.add_argument("input_topic")
+    runp.add_argument("output_topic")
+    runp.add_argument("--config", help="TOML/JSON config file")
+    runp.add_argument("--set", action="append", default=[],
+                      metavar="section.key=value")
+    runp.add_argument("--duration", type=float, default=0.0,
+                      help="run window in seconds (0 = until signal); the "
+                           "reference hard-killed after 3600s")
+
+    servep = sub.add_parser("serve", help="run the gRPC TPU inference worker")
+    servep.add_argument("--config", help="TOML/JSON config file")
+    servep.add_argument("--set", action="append", default=[])
+    servep.add_argument("--model", default=None, help="model registry name")
+    servep.add_argument("--port", type=int, default=50051)
+
+    sub.add_parser("info", help="print devices and registered models")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "run":
+        cfg = _load_config(args)
+        cfg.broker.input_topic = args.input_topic
+        cfg.broker.output_topic = args.output_topic
+        asyncio.run(_run_daemon(args.name, cfg, args.duration))
+        return 0
+
+    if args.cmd == "serve":
+        cfg = _load_config(args)
+        if args.model:
+            cfg.model.name = args.model
+        from storm_tpu.serve import InferenceWorker
+
+        worker = InferenceWorker(cfg.model, cfg.sharding, cfg.batch, port=args.port)
+        worker.start()
+        print(f"serving {cfg.model.name} on port {worker.port}", file=sys.stderr)
+        try:
+            worker.wait()
+        except KeyboardInterrupt:
+            worker.stop()
+        return 0
+
+    if args.cmd == "info":
+        import jax
+
+        from storm_tpu.models import registry_names
+
+        print(json.dumps({
+            "devices": [str(d) for d in jax.devices()],
+            "models": registry_names(),
+            "version": __import__("storm_tpu").__version__,
+        }, indent=2))
+        return 0
+
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

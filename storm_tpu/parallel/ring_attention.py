@@ -1,0 +1,101 @@
+"""Ring attention: sequence-parallel attention over the device mesh.
+
+The reference has no sequence axis at all (fixed 4-D image tensors,
+InstObj.java:8; SURVEY.md §5.7) — but long-context models served by this
+framework need attention over sequences that do not fit one chip. This is
+the TPU-idiomatic construction:
+
+- the sequence axis is sharded over a mesh axis (``shard_map``);
+- each device computes blockwise attention of its local queries against the
+  KV shard it currently holds, carrying online-softmax statistics
+  (running max ``m``, denominator ``l``, unnormalized accumulator ``acc``);
+- KV shards rotate around the ring with ``lax.ppermute`` — the collective
+  rides ICI neighbor links, overlapping with the next block's compute under
+  XLA's scheduler (the pattern of Liu et al.'s Ring Attention, built from
+  public JAX primitives);
+- after ``n`` hops every query has seen every key; the carry normalizes to
+  the exact softmax result — bitwise-independent of how many ways the
+  sequence was sharded (up to float reassociation).
+
+Non-causal (bidirectional) attention, matching the ViT/encoder workloads
+this framework serves; a causal variant would add a step-index mask.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _partial_attention(q, k, v, scale):
+    """Blockwise attention with online-softmax statistics.
+
+    q: (B, H, Sq, D); k/v: (B, H, Sk, D) ->
+    (acc: (B, H, Sq, D) unnormalized, m: (B, H, Sq), l: (B, H, Sq))
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    m = s.max(axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return acc, m, l
+
+
+def _merge(m, l, acc, m_j, l_j, acc_j):
+    m_new = jnp.maximum(m, m_j)
+    a = jnp.exp(m - m_new)
+    b = jnp.exp(m_j - m_new)
+    return (
+        m_new,
+        l * a + l_j * b,
+        acc * a[..., None] + acc_j * b[..., None],
+    )
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    seq_axis: str = "data",
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact softmax(q k^T * scale) v with the sequence axis sharded over
+    ``mesh[seq_axis]``. Inputs/outputs are global (B, H, S, D) arrays whose
+    S axis is (or will be) sharded; S must divide evenly by the axis size."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = mesh.shape[seq_axis]
+    if q.shape[2] % n:
+        raise ValueError(f"sequence {q.shape[2]} not divisible by {n}-way {seq_axis!r}")
+    spec = P(None, None, seq_axis, None)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def inner(ql, kl, vl):
+        acc, m, l = _partial_attention(ql, kl, vl, scale)
+
+        def body(_, carry):
+            k_cur, v_cur, m, l, acc = carry
+            # Rotate KV shards one hop around the ring (ICI neighbors).
+            k_nxt = lax.ppermute(k_cur, seq_axis, perm)
+            v_nxt = lax.ppermute(v_cur, seq_axis, perm)
+            acc_j, m_j, l_j = _partial_attention(ql, k_nxt, v_nxt, scale)
+            m, l, acc = _merge(m, l, acc, m_j, l_j, acc_j)
+            return k_nxt, v_nxt, m, l, acc
+
+        _, _, m, l, acc = lax.fori_loop(0, n - 1, body, (kl, vl, m, l, acc))
+        return (acc / l[..., None]).astype(ql.dtype)
+
+    return inner(q, k, v)

@@ -132,6 +132,73 @@ class TopologyRuntime:
             n = self.ledger.sweep()
             if n:
                 log.warning("%s: %d tuple trees timed out", self.name, n)
+            self._supervise()
+
+    def _supervise(self) -> None:
+        """Storm-supervisor analog: an executor task that died (bug in
+        framework code — user exceptions are caught in the loop) is replaced
+        with a fresh component clone on the same inbox."""
+        tcfg = self.config.topology
+
+        def replace(cid, i, execs, old, make_fresh, dispose):
+            exc = old._task.exception()
+            log.error("executor %s[%d] died (%r); restarting", cid, i, exc)
+            self.metrics.counter(cid, "executor_restarts").inc()
+            try:
+                dispose()  # release the crashed component's resources
+            except Exception as ce:
+                log.warning("cleanup of dead %s[%d] failed: %s", cid, i, ce)
+            fresh = make_fresh(clone_component(self.topology.specs[cid].obj))
+            execs[i] = fresh
+            fresh.start()
+            return fresh
+
+        def died(e) -> bool:
+            return e._task is not None and e._task.done() and not e._task.cancelled()
+
+        for cid, execs in self.bolt_execs.items():
+            for i, e in enumerate(execs):
+                if died(e):
+                    if e._tick_task is not None:
+                        e._tick_task.cancel()  # or the old ticker keeps feeding the inbox
+
+                    replace(
+                        cid, i, execs, e,
+                        lambda proto, e=e, cid=cid, i=i: BoltExecutor(
+                            self, cid, i, proto,
+                            tcfg.inbox_capacity, tcfg.tick_interval_s, inbox=e.inbox,
+                        ),
+                        e.bolt.cleanup,
+                    )
+        for cid, execs in self.spout_execs.items():
+            for i, e in enumerate(execs):
+                if died(e):
+                    fresh = replace(
+                        cid, i, execs, e,
+                        lambda proto, cid=cid, i=i: SpoutExecutor(
+                            self, cid, i, proto, tcfg.max_spout_pending
+                        ),
+                        e.spout.close,
+                    )
+                    # Preserve deactivation: a drain in progress must not be
+                    # resurrected into an emitting spout.
+                    fresh._active = e._active
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness snapshot: executor task states + in-flight counts."""
+        comps: Dict[str, Any] = {}
+        for cid, execs in {**self.bolt_execs, **self.spout_execs}.items():
+            comps[cid] = {
+                "tasks": len(execs),
+                "alive": sum(
+                    1 for e in execs if e._task is not None and not e._task.done()
+                ),
+            }
+        return {
+            "topology": self.name,
+            "inflight_trees": self.ledger.inflight,
+            "components": comps,
+        }
 
     # ---- runtime services (used by collectors/executors) ---------------------
 

@@ -34,12 +34,17 @@ class BoltExecutor:
         bolt: Bolt,
         inbox_capacity: int,
         tick_interval_s: float = 0.0,
+        inbox: Optional[asyncio.Queue] = None,
     ) -> None:
         self.rt = runtime
         self.component_id = component_id
         self.task_index = task_index
         self.bolt = bolt
-        self.inbox: asyncio.Queue = asyncio.Queue(maxsize=inbox_capacity)
+        # A supervisor restart hands over the previous executor's inbox so
+        # upstream routing tables stay valid across the swap.
+        self.inbox: asyncio.Queue = inbox if inbox is not None else asyncio.Queue(
+            maxsize=inbox_capacity
+        )
         self.tick_interval_s = tick_interval_s
         self._task: Optional[asyncio.Task] = None
         self._tick_task: Optional[asyncio.Task] = None
@@ -72,8 +77,11 @@ class BoltExecutor:
                 pass
 
     async def _run(self) -> None:
+        import time as _time
+
         m = self.rt.metrics
         executed = m.counter(self.component_id, "executed")
+        exec_ms = m.histogram(self.component_id, "execute_ms")
         while True:
             item = await self.inbox.get()
             if item is _STOP:
@@ -84,7 +92,9 @@ class BoltExecutor:
                     await self.bolt.tick()
                 else:
                     executed.inc()
+                    t0 = _time.perf_counter()
                     await self.bolt.execute(t)
+                    exec_ms.observe((_time.perf_counter() - t0) * 1e3)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # fail the tuple, keep the executor alive

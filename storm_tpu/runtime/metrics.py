@@ -63,12 +63,15 @@ class Histogram:
         return self.sum / self.count if self.count else float("nan")
 
     def snapshot(self) -> Dict[str, float]:
+        def clean(v: float):
+            return None if v != v else v  # NaN -> None (JSON-safe)
+
         return {
             "count": self.count,
-            "mean": self.mean,
-            "p50": self.percentile(50),
-            "p95": self.percentile(95),
-            "p99": self.percentile(99),
+            "mean": clean(self.mean),
+            "p50": clean(self.percentile(50)),
+            "p95": clean(self.percentile(95)),
+            "p99": clean(self.percentile(99)),
         }
 
 

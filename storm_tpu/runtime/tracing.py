@@ -1,0 +1,47 @@
+"""Tracing: per-stage spans + JAX device profiler integration.
+
+The reference's observability is whatever Storm UI exposes (SURVEY.md §5.1);
+here spans are first-class and the device side hooks into ``jax.profiler``
+so a trace shows host batching and XLA execution on one timeline.
+
+Usage::
+
+    with span(metrics, "inference-bolt", "decode"):
+        ...                      # records decode_ms histogram
+
+    with device_trace("/tmp/trace"):   # TensorBoard-loadable profile
+        engine.predict(x)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from storm_tpu.runtime.metrics import MetricsRegistry
+
+
+@contextlib.contextmanager
+def span(metrics: Optional[MetricsRegistry], component: str, name: str) -> Iterator[None]:
+    """Time a stage into the ``<name>_ms`` histogram of ``component``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if metrics is not None:
+            metrics.histogram(component, f"{name}_ms").observe(
+                (time.perf_counter() - t0) * 1e3
+            )
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """JAX/XLA profiler trace (host + device timelines) into ``log_dir``."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

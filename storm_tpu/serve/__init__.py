@@ -1,0 +1,4 @@
+from storm_tpu.serve.worker import InferenceWorker
+from storm_tpu.serve.client import InferenceClient
+
+__all__ = ["InferenceWorker", "InferenceClient"]

@@ -1,0 +1,49 @@
+"""Client for the gRPC inference worker (what a JVM InferenceBolt's
+``execute`` would call instead of JNI -> libtensorflow)."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from storm_tpu.serve.marshal import decode_tensor, encode_tensor
+
+_SERVICE = "storm_tpu.Inference"
+
+
+class InferenceClient:
+    def __init__(self, target: str = "localhost:50051") -> None:
+        self._channel = grpc.insecure_channel(
+            target,
+            options=[
+                ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                ("grpc.max_send_message_length", 256 * 1024 * 1024),
+            ],
+        )
+        self._predict = self._channel.unary_unary(f"/{_SERVICE}/Predict")
+        self._predict_json = self._channel.unary_unary(f"/{_SERVICE}/PredictJson")
+        self._info = self._channel.unary_unary(f"/{_SERVICE}/Info")
+
+    def predict(self, x: np.ndarray, timeout: Optional[float] = 60.0) -> np.ndarray:
+        """Arrow-tensor round trip: (N, ...) batch in, (N, K) scores out."""
+        return decode_tensor(self._predict(encode_tensor(x), timeout=timeout))
+
+    def predict_json(self, payload: str | bytes, timeout: Optional[float] = 60.0) -> str:
+        if isinstance(payload, str):
+            payload = payload.encode("utf-8")
+        return self._predict_json(payload, timeout=timeout).decode("utf-8")
+
+    def info(self, timeout: Optional[float] = 10.0) -> dict:
+        return json.loads(self._info(b"", timeout=timeout))
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self) -> "InferenceClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

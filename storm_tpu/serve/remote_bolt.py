@@ -1,0 +1,61 @@
+"""RemoteInferenceBolt: inference operator that dispatches to the gRPC
+worker instead of an in-process engine — the in-tree realization of the
+north-star split (BASELINE.json): a front-end runtime (here our own; in the
+reference architecture a JVM Storm bolt) keeps tuple-ack semantics while
+batches cross a localhost gRPC + Arrow boundary to the TPU worker process.
+
+Identical streaming behavior to :class:`storm_tpu.infer.InferenceBolt`
+(micro-batching, deferred acks, dead-lettering); only the engine call is
+remote."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional, Set
+
+from storm_tpu.api.schema import DeadLetter, SchemaError, decode_instances, encode_predictions
+from storm_tpu.config import BatchConfig
+from storm_tpu.infer.batcher import Batch, MicroBatcher
+from storm_tpu.infer.operator import InferenceBolt
+from storm_tpu.runtime.base import TopologyContext, OutputCollector
+from storm_tpu.serve.client import InferenceClient
+
+
+class RemoteInferenceBolt(InferenceBolt):
+    def __init__(
+        self,
+        target: str = "localhost:50051",
+        batch: Optional[BatchConfig] = None,
+        warmup: bool = False,
+    ) -> None:
+        super().__init__(batch=batch, warmup=warmup)
+        self.target = target
+
+    def clone(self) -> "RemoteInferenceBolt":
+        return RemoteInferenceBolt(self.target, self.batch_cfg, self._warmup)
+
+    def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
+        # Skip the in-process engine entirely; resolve shape from the worker.
+        self.client = InferenceClient(self.target)
+        info = self.client.info()
+        self._input_shape = tuple(info["input_shape"])
+
+        class _RemoteEngine:
+            """Engine facade: predict() over gRPC; shape from Info."""
+
+            input_shape = self._input_shape
+            client = self.client
+
+            def predict(self_inner, x):
+                return self.client.predict(x)
+
+            def warmup(self_inner):
+                pass
+
+        self._engine = _RemoteEngine()
+        super().prepare(context, collector)
+
+    def cleanup(self) -> None:
+        super().cleanup()
+        self.client.close()

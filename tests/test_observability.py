@@ -1,0 +1,128 @@
+"""Supervision, health, spans, multi-model co-residency (BASELINE config 5)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from storm_tpu.config import (
+    BatchConfig,
+    Config,
+    ModelConfig,
+    OffsetsConfig,
+    ShardingConfig,
+)
+from storm_tpu.connectors import BrokerSink, BrokerSpout, MemoryBroker
+from storm_tpu.infer import InferenceBolt
+from storm_tpu.runtime import Bolt, TopologyBuilder
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+from storm_tpu.runtime.tracing import span
+from storm_tpu.runtime.metrics import MetricsRegistry
+
+
+def test_span_records_histogram():
+    m = MetricsRegistry()
+    with span(m, "comp", "decode"):
+        pass
+    snap = m.snapshot()
+    assert snap["comp"]["decode_ms"]["count"] == 1
+
+
+def test_supervisor_restarts_dead_executor(run):
+    """Kill an executor task behind the runtime's back; the supervisor
+    replaces it and the topology keeps delivering."""
+    from tests.test_runtime import CaptureBolt, ListSpout, settle
+
+    CaptureBolt.seen = None
+
+    async def go():
+        cfg = Config()
+        cfg.topology.message_timeout_s = 2.0  # fast sweep loop
+        cluster = AsyncLocalCluster()
+        tb = TopologyBuilder()
+        spout = ListSpout([f"m{i}" for i in range(6)])
+        tb.set_spout("s", spout, 1)
+        tb.set_bolt("c", CaptureBolt(), 1).shuffle_grouping("s")
+        rt = await cluster.submit("t", cfg, tb.build())
+        await settle(rt, "s", 6)
+        # simulate a framework-level crash
+        rt.bolt_execs["c"][0]._task.cancel()  # cancelled -> NOT restarted
+        await asyncio.sleep(0.1)
+        victim = rt.bolt_execs["c"][0]
+        victim._task = asyncio.get_event_loop().create_task(_boom())
+        await asyncio.sleep(0.05)
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if rt.metrics.counter("c", "executor_restarts").value:
+                break
+        restarted = rt.bolt_execs["c"][0] is not victim
+        health = rt.health()
+        await cluster.shutdown()
+        return restarted, health
+
+    async def _boom():
+        raise RuntimeError("framework bug")
+
+    restarted, health = run(go(), timeout=30)
+    assert restarted
+    assert health["components"]["c"]["alive"] == 1
+
+
+def test_multi_model_topology_shares_process(run):
+    """Two models co-resident (BASELINE config 5): MNIST + CIFAR topics
+    routed to different InferenceBolts, separate engines, one runtime."""
+
+    async def go():
+        broker = MemoryBroker(default_partitions=1)
+        cfg = Config()
+        off = OffsetsConfig(policy="earliest", max_behind=None)
+        bat = BatchConfig(max_batch=4, max_wait_ms=10, buckets=(4,))
+        shard = ShardingConfig(data_parallel=0)
+
+        tb = TopologyBuilder()
+        tb.set_spout("mnist-in", BrokerSpout(broker, "mnist", off), 1)
+        tb.set_spout("cifar-in", BrokerSpout(broker, "cifar", off), 1)
+        tb.set_bolt(
+            "mnist-bolt",
+            InferenceBolt(
+                ModelConfig(name="lenet5", dtype="float32", input_shape=(28, 28, 1)),
+                bat, shard, warmup=False,
+            ),
+            1,
+        ).shuffle_grouping("mnist-in")
+        tb.set_bolt(
+            "cifar-bolt",
+            InferenceBolt(
+                ModelConfig(name="resnet20", dtype="float32", input_shape=(32, 32, 3)),
+                bat, shard, warmup=False,
+            ),
+            1,
+        ).shuffle_grouping("cifar-in")
+        tb.set_bolt("mnist-out", BrokerSink(broker, "mnist-preds", cfg.sink), 1)\
+            .shuffle_grouping("mnist-bolt")
+        tb.set_bolt("cifar-out", BrokerSink(broker, "cifar-preds", cfg.sink), 1)\
+            .shuffle_grouping("cifar-bolt")
+
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("multi", cfg, tb.build())
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            broker.produce("mnist", json.dumps(
+                {"instances": rng.rand(1, 28, 28, 1).tolist()}))
+            broker.produce("cifar", json.dumps(
+                {"instances": rng.rand(1, 32, 32, 3).tolist()}))
+        deadline = asyncio.get_event_loop().time() + 90
+        while asyncio.get_event_loop().time() < deadline:
+            if (broker.topic_size("mnist-preds") >= 4
+                    and broker.topic_size("cifar-preds") >= 4):
+                break
+            await asyncio.sleep(0.05)
+        res = (broker.drain_topic("mnist-preds"), broker.drain_topic("cifar-preds"))
+        await cluster.shutdown()
+        return res
+
+    mnist, cifar = run(go(), timeout=120)
+    assert len(mnist) == 4 and len(cifar) == 4
+    assert len(json.loads(mnist[0].value)["predictions"][0]) == 10
+    assert len(json.loads(cifar[0].value)["predictions"][0]) == 10

@@ -1,0 +1,128 @@
+"""gRPC worker tests: Arrow tensor round trip, JSON contract, error codes,
+and the remote-operator topology (north-star split)."""
+
+import json
+
+import grpc
+import numpy as np
+import pytest
+
+from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+from storm_tpu.serve import InferenceClient, InferenceWorker
+from storm_tpu.serve.marshal import decode_tensor, encode_tensor
+
+
+def test_marshal_roundtrip_zero_copy():
+    x = np.random.rand(4, 8, 8, 1).astype(np.float32)
+    buf = encode_tensor(x)
+    back = decode_tensor(buf)
+    np.testing.assert_array_equal(back, x)
+    assert back.dtype == np.float32
+
+
+@pytest.fixture(scope="module")
+def worker():
+    w = InferenceWorker(
+        ModelConfig(name="lenet5", dtype="float32", input_shape=(28, 28, 1)),
+        ShardingConfig(data_parallel=1),
+        BatchConfig(max_batch=16, buckets=(16,)),
+        port=0,  # ephemeral
+    ).start()
+    yield w
+    w.stop()
+
+
+@pytest.fixture()
+def client(worker):
+    with InferenceClient(f"localhost:{worker.port}") as c:
+        yield c
+
+
+def test_worker_info(client):
+    info = client.info()
+    assert info["model"] == "lenet5"
+    assert info["input_shape"] == [28, 28, 1]
+    assert info["num_classes"] == 10
+
+
+def test_worker_predict_arrow(client):
+    x = np.random.rand(3, 28, 28, 1).astype(np.float32)
+    out = client.predict(x)
+    assert out.shape == (3, 10)
+    np.testing.assert_allclose(out.sum(-1), np.ones(3), atol=1e-4)
+
+
+def test_worker_predict_json(client):
+    x = np.random.rand(2, 28, 28, 1)
+    resp = client.predict_json(json.dumps({"instances": x.tolist()}))
+    preds = json.loads(resp)["predictions"]
+    assert len(preds) == 2 and len(preds[0]) == 10
+
+
+def test_worker_rejects_bad_shape(client):
+    with pytest.raises(grpc.RpcError) as ei:
+        client.predict(np.zeros((1, 5, 5, 1), np.float32))
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_worker_rejects_garbage_tensor(worker):
+    ch = grpc.insecure_channel(f"localhost:{worker.port}")
+    call = ch.unary_unary("/storm_tpu.Inference/Predict")
+    with pytest.raises(grpc.RpcError) as ei:
+        call(b"not an arrow tensor")
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    ch.close()
+
+
+def test_worker_rejects_bad_json(client):
+    with pytest.raises(grpc.RpcError) as ei:
+        client.predict_json('{"instances": [[1,2],[3]]}')
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_remote_bolt_topology(worker, run):
+    """Full streaming topology where inference crosses the gRPC boundary."""
+    import asyncio
+
+    from storm_tpu.api.schema import decode_predictions
+    from storm_tpu.config import Config, OffsetsConfig
+    from storm_tpu.connectors import BrokerSink, BrokerSpout, MemoryBroker
+    from storm_tpu.runtime import TopologyBuilder
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+    from storm_tpu.serve.remote_bolt import RemoteInferenceBolt
+
+    async def go():
+        broker = MemoryBroker(default_partitions=2)
+        cfg = Config()
+        tb = TopologyBuilder()
+        tb.set_spout(
+            "in", BrokerSpout(broker, "input", OffsetsConfig(policy="earliest", max_behind=None)), 1
+        )
+        tb.set_bolt(
+            "infer",
+            RemoteInferenceBolt(
+                f"localhost:{worker.port}",
+                BatchConfig(max_batch=8, max_wait_ms=10, buckets=(8,)),
+            ),
+            2,
+        ).shuffle_grouping("in")
+        tb.set_bolt("out", BrokerSink(broker, "output", cfg.sink), 1).shuffle_grouping("infer")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("remote", cfg, tb.build())
+        for i in range(5):
+            broker.produce("input", json.dumps(
+                {"instances": np.random.rand(1, 28, 28, 1).tolist()}
+            ))
+        deadline = asyncio.get_event_loop().time() + 30
+        while asyncio.get_event_loop().time() < deadline:
+            if broker.topic_size("output") >= 5:
+                break
+            await asyncio.sleep(0.05)
+        outs = broker.drain_topic("output")
+        await cluster.shutdown()
+        return outs
+
+    outs = run(go(), timeout=60)
+    assert len(outs) == 5
+    for r in outs:
+        assert decode_predictions(r.value).data.shape == (1, 10)
