@@ -1,4 +1,10 @@
-"""Real-Kafka connectors (gated on an installed client library).
+"""Real-Kafka connectors via an installed client library (OPTIONAL path).
+
+NOTE: the primary real-Kafka path is
+:mod:`storm_tpu.connectors.kafka_protocol` — a dependency-free wire-protocol
+client that backs ``BrokerConfig.kind='kafka'``. This module remains for
+deployments that prefer a full-featured client (compression, SASL/TLS,
+group rebalancing) when one is installed.
 
 The deployment environment this framework is developed in has no Kafka
 client wheel; these adapters activate when ``aiokafka`` or

@@ -1,0 +1,555 @@
+"""Pure-Python Kafka wire-protocol client (no external client library).
+
+The reference reaches Kafka through storm-kafka + kafka-clients jars
+(pom.xml:39-78); this environment has no Kafka client wheel at all, so the
+framework speaks the binary protocol directly. Deliberately targets the
+old, stable, non-flexible encodings every broker since 0.10 accepts —
+the same era as the reference's Kafka 0.11 (pom.xml:55-78):
+
+- Metadata v0 (api 3) — brokers + partition leaders
+- Produce v2 (api 0) — message-format v1 sets (crc/magic/attrs/ts/key/value)
+- Fetch v2 (api 1) — brokers down-convert to message format v1
+- ListOffsets v0 (api 2) — latest (-1) / earliest (-2)
+- FindCoordinator v0 (api 10) — group coordinator for offset storage
+- OffsetCommit v2 (api 8) / OffsetFetch v1 (api 9) — "simple consumer"
+  commits (generation -1, empty member), no group-membership protocol
+
+Compression is not used (attributes=0); compressed fetches from other
+producers are rejected with a clear error rather than silently dropped.
+
+:class:`KafkaWireBroker` adapts this client to the same surface as
+:class:`storm_tpu.connectors.memory.MemoryBroker`, so ``BrokerSpout`` /
+``BrokerSink`` run unchanged against a real cluster (``blocking = True``
+tells the spout to fetch via a worker thread). Exercised end-to-end in
+tests against an in-process stub broker speaking the same protocol over
+real sockets (tests/kafka_stub.py).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from storm_tpu.connectors.memory import Record
+
+
+class KafkaProtocolError(RuntimeError):
+    pass
+
+
+# ---- primitive encoding ------------------------------------------------------
+
+
+class Writer:
+    def __init__(self) -> None:
+        self.buf = bytearray()
+
+    def i8(self, v):  self.buf += struct.pack(">b", v); return self
+    def i16(self, v): self.buf += struct.pack(">h", v); return self
+    def i32(self, v): self.buf += struct.pack(">i", v); return self
+    def i64(self, v): self.buf += struct.pack(">q", v); return self
+
+    def string(self, s: Optional[str]):
+        if s is None:
+            return self.i16(-1)
+        b = s.encode("utf-8")
+        self.i16(len(b))
+        self.buf += b
+        return self
+
+    def bytes_(self, b: Optional[bytes]):
+        if b is None:
+            return self.i32(-1)
+        self.i32(len(b))
+        self.buf += b
+        return self
+
+    def raw(self, b: bytes):
+        self.buf += b
+        return self
+
+
+class Reader:
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise KafkaProtocolError("short read in response")
+        b = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return b
+
+    def i8(self) -> int:  return struct.unpack(">b", self._take(1))[0]
+    def i16(self) -> int: return struct.unpack(">h", self._take(2))[0]
+    def i32(self) -> int: return struct.unpack(">i", self._take(4))[0]
+    def i64(self) -> int: return struct.unpack(">q", self._take(8))[0]
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        return None if n < 0 else self._take(n).decode("utf-8")
+
+    def bytes_(self) -> Optional[bytes]:
+        n = self.i32()
+        return None if n < 0 else self._take(n)
+
+    @property
+    def remaining(self) -> int:
+        return len(self.data) - self.pos
+
+
+# ---- message sets (format v1) ------------------------------------------------
+
+
+def encode_message_set(
+    records: List[Tuple[Optional[bytes], bytes]],
+    ts_ms: int,
+    offsets: Optional[List[int]] = None,
+) -> bytes:
+    """[(key, value)] -> MessageSet with magic-1 messages, no compression.
+
+    ``offsets`` is used by the broker side (tests/kafka_stub.py) to encode
+    real log offsets; producers leave it None (the broker assigns)."""
+    out = bytearray()
+    for i, (key, value) in enumerate(records):
+        msg = Writer()
+        msg.i8(1)      # magic
+        msg.i8(0)      # attributes (no compression)
+        msg.i64(ts_ms)
+        msg.bytes_(key)
+        msg.bytes_(value)
+        crc = zlib.crc32(bytes(msg.buf)) & 0xFFFFFFFF
+        full = Writer()
+        full.i64(offsets[i] if offsets else 0)
+        full.i32(4 + len(msg.buf))
+        full.buf += struct.pack(">I", crc)
+        full.raw(bytes(msg.buf))
+        out += full.buf
+    return bytes(out)
+
+
+def decode_message_set(topic: str, partition: int, data: bytes) -> List[Record]:
+    """MessageSet (v0/v1 messages) -> Records. RecordBatch (magic 2) and
+    compressed sets are rejected explicitly."""
+    records: List[Record] = []
+    r = Reader(data)
+    while r.remaining >= 12:
+        offset = r.i64()
+        size = r.i32()
+        if r.remaining < size:
+            break  # partial trailing message (Kafka truncates at max_bytes)
+        body = Reader(r._take(size))
+        body.i32()  # crc (trusted; TCP already checksums)
+        magic = body.i8()
+        if magic == 2:
+            raise KafkaProtocolError(
+                "broker returned record-batch format (magic 2); request a "
+                "Fetch version the broker down-converts for"
+            )
+        attrs = body.i8()
+        if attrs & 0x07:
+            raise KafkaProtocolError("compressed message sets not supported")
+        ts = body.i64() / 1e3 if magic == 1 else time.time()
+        key = body.bytes_()
+        value = body.bytes_() or b""
+        records.append(Record(topic, partition, offset, key, value, ts))
+    return records
+
+
+# ---- connection --------------------------------------------------------------
+
+
+class _Conn:
+    def __init__(self, host: str, port: int, client_id: str, timeout: float) -> None:
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.client_id = client_id
+        self.lock = threading.Lock()
+        self._corr = 0
+
+    def request(
+        self, api_key: int, api_version: int, body: bytes, oneway: bool = False
+    ) -> Optional[Reader]:
+        """``oneway`` skips the response read — required for acks=0 produce,
+        where the broker sends nothing back."""
+        with self.lock:
+            self._corr += 1
+            corr = self._corr
+            head = Writer()
+            head.i16(api_key).i16(api_version).i32(corr).string(self.client_id)
+            payload = bytes(head.buf) + body
+            self.sock.sendall(struct.pack(">i", len(payload)) + payload)
+            if oneway:
+                return None
+            size = struct.unpack(">i", self._recv(4))[0]
+            resp = Reader(self._recv(size))
+        got = resp.i32()
+        if got != corr:
+            raise KafkaProtocolError(f"correlation mismatch {got} != {corr}")
+        return resp
+
+    def _recv(self, n: int) -> bytes:
+        chunks = bytearray()
+        while len(chunks) < n:
+            c = self.sock.recv(n - len(chunks))
+            if not c:
+                raise KafkaProtocolError("connection closed by broker")
+            chunks += c
+        return bytes(chunks)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---- client ------------------------------------------------------------------
+
+
+@dataclass
+class _PartitionMeta:
+    leader: int
+
+
+class KafkaWireClient:
+    def __init__(
+        self,
+        bootstrap: str,
+        client_id: str = "storm-tpu",
+        timeout: float = 30.0,
+    ) -> None:
+        host, _, port = bootstrap.partition(":")
+        self.bootstrap = (host, int(port or 9092))
+        self.client_id = client_id
+        self.timeout = timeout
+        self._conns: Dict[Tuple[str, int], _Conn] = {}
+        self._brokers: Dict[int, Tuple[str, int]] = {}
+        self._meta: Dict[str, Dict[int, _PartitionMeta]] = {}
+        self._coordinators: Dict[str, Tuple[str, int]] = {}
+        self._lock = threading.Lock()
+
+    # -- connections ----------------------------------------------------------
+
+    def _conn(self, addr: Tuple[str, int]) -> _Conn:
+        with self._lock:
+            c = self._conns.get(addr)
+            if c is None:
+                c = _Conn(addr[0], addr[1], self.client_id, self.timeout)
+                self._conns[addr] = c
+            return c
+
+    def _evict(self, addr: Tuple[str, int], conn: _Conn) -> None:
+        with self._lock:
+            if self._conns.get(addr) is conn:
+                del self._conns[addr]
+        conn.close()
+
+    def _request(
+        self,
+        addr: Tuple[str, int],
+        api_key: int,
+        api_version: int,
+        body: bytes,
+        oneway: bool = False,
+        _retry: bool = True,
+    ) -> Optional[Reader]:
+        """Request with one transparent reconnect: a dead cached connection
+        (broker restart, idle-closed socket) is evicted and the request
+        retried on a fresh one, so a single TCP drop doesn't poison a
+        long-running topology. At-least-once semantics tolerate the rare
+        duplicate produce a retry can cause."""
+        conn = self._conn(addr)
+        try:
+            return conn.request(api_key, api_version, body, oneway)
+        except (OSError, KafkaProtocolError):
+            self._evict(addr, conn)
+            if not _retry:
+                raise
+            return self._request(addr, api_key, api_version, body, oneway, _retry=False)
+
+    def _leader_addr(self, topic: str, partition: int) -> Tuple[str, int]:
+        meta = self._meta.get(topic)
+        if meta is None or partition not in meta:
+            self.refresh_metadata([topic])
+            meta = self._meta.get(topic)
+            if meta is None or partition not in meta:
+                raise KafkaProtocolError(f"unknown partition {topic}[{partition}]")
+        leader = meta[partition].leader
+        return self._brokers.get(leader, self.bootstrap)
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._conns.values():
+                c.close()
+            self._conns.clear()
+
+    # -- metadata -------------------------------------------------------------
+
+    def refresh_metadata(self, topics: Optional[List[str]] = None) -> None:
+        w = Writer()
+        ts = topics or []
+        w.i32(len(ts))
+        for t in ts:
+            w.string(t)
+        r = self._request(self.bootstrap, 3, 0, bytes(w.buf))
+        n_brokers = r.i32()
+        brokers = {}
+        for _ in range(n_brokers):
+            node = r.i32()
+            host = r.string()
+            port = r.i32()
+            brokers[node] = (host, port)
+        self._brokers = brokers
+        n_topics = r.i32()
+        for _ in range(n_topics):
+            err = r.i16()
+            name = r.string()
+            n_parts = r.i32()
+            parts = {}
+            for _ in range(n_parts):
+                r.i16()  # partition error
+                pid = r.i32()
+                leader = r.i32()
+                for _ in range(r.i32()):
+                    r.i32()  # replicas
+                for _ in range(r.i32()):
+                    r.i32()  # isr
+                parts[pid] = _PartitionMeta(leader)
+            if err == 0:
+                self._meta[name] = parts
+
+    def partitions_for(self, topic: str) -> int:
+        if topic not in self._meta:
+            self.refresh_metadata([topic])
+        return max(1, len(self._meta.get(topic, {})))
+
+    # -- produce --------------------------------------------------------------
+
+    def produce(
+        self,
+        topic: str,
+        partition: int,
+        records: List[Tuple[Optional[bytes], bytes]],
+        acks: int = 1,
+        timeout_ms: int = 30000,
+    ) -> int:
+        """Returns the base offset assigned by the broker."""
+        msgset = encode_message_set(records, int(time.time() * 1e3))
+        w = Writer()
+        w.i16(acks).i32(timeout_ms)
+        w.i32(1)
+        w.string(topic)
+        w.i32(1)
+        w.i32(partition)
+        w.bytes_(msgset)
+        addr = self._leader_addr(topic, partition)
+        if acks == 0:
+            # Broker sends no response for acks=0; reading one would hang.
+            self._request(addr, 0, 2, bytes(w.buf), oneway=True)
+            return -1
+        r = self._request(addr, 0, 2, bytes(w.buf))
+        base_offset = -1
+        for _ in range(r.i32()):  # topics
+            r.string()
+            for _ in range(r.i32()):  # partitions
+                r.i32()  # partition id
+                err = r.i16()
+                base_offset = r.i64()
+                r.i64()  # log_append_time
+                if err:
+                    raise KafkaProtocolError(f"produce error code {err}")
+        r.i32()  # throttle
+        return base_offset
+
+    # -- fetch ----------------------------------------------------------------
+
+    def fetch(
+        self,
+        topic: str,
+        partition: int,
+        offset: int,
+        max_bytes: int = 1 << 20,
+        max_wait_ms: int = 100,
+        min_bytes: int = 1,
+    ) -> List[Record]:
+        w = Writer()
+        w.i32(-1).i32(max_wait_ms).i32(min_bytes)
+        w.i32(1)
+        w.string(topic)
+        w.i32(1)
+        w.i32(partition).i64(offset).i32(max_bytes)
+        r = self._request(self._leader_addr(topic, partition), 1, 2, bytes(w.buf))
+        r.i32()  # throttle
+        out: List[Record] = []
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()  # partition
+                err = r.i16()
+                r.i64()  # high watermark
+                data = r.bytes_() or b""
+                if err:
+                    raise KafkaProtocolError(f"fetch error code {err}")
+                out.extend(decode_message_set(topic, partition, data))
+        # Skip messages below the requested offset (brokers may return the
+        # whole containing batch).
+        return [rec for rec in out if rec.offset >= offset]
+
+    # -- offsets --------------------------------------------------------------
+
+    def list_offset(self, topic: str, partition: int, timestamp: int) -> int:
+        """timestamp -1 = log end, -2 = log start."""
+        w = Writer()
+        w.i32(-1)
+        w.i32(1)
+        w.string(topic)
+        w.i32(1)
+        w.i32(partition).i64(timestamp).i32(1)
+        r = self._request(self._leader_addr(topic, partition), 2, 0, bytes(w.buf))
+        result = 0
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                err = r.i16()
+                if err:
+                    raise KafkaProtocolError(f"list_offsets error code {err}")
+                n = r.i32()
+                offsets = [r.i64() for _ in range(n)]
+                if offsets:
+                    result = offsets[0]
+        return result
+
+    def _coordinator_addr(self, group: str) -> Tuple[str, int]:
+        """Coordinator lookup, cached per group (refreshing on every commit
+        would cost an extra round trip per acked tuple)."""
+        with self._lock:
+            cached = self._coordinators.get(group)
+        if cached is not None:
+            return cached
+        w = Writer()
+        w.string(group)
+        r = self._request(self.bootstrap, 10, 0, bytes(w.buf))
+        err = r.i16()
+        r.i32()  # node id
+        host = r.string()
+        port = r.i32()
+        if err:
+            raise KafkaProtocolError(f"find_coordinator error code {err}")
+        with self._lock:
+            self._coordinators[group] = (host, port)
+        return (host, port)
+
+    def _coordinator_request(
+        self, group: str, api: int, version: int, body: bytes
+    ) -> Reader:
+        try:
+            return self._request(self._coordinator_addr(group), api, version, body)
+        except (OSError, KafkaProtocolError):
+            # Coordinator may have moved; re-discover once.
+            with self._lock:
+                self._coordinators.pop(group, None)
+            return self._request(self._coordinator_addr(group), api, version, body)
+
+    def offset_commit(self, group: str, topic: str, partition: int, offset: int) -> None:
+        w = Writer()
+        w.string(group)
+        w.i32(-1)      # generation (simple consumer)
+        w.string("")   # member id
+        w.i64(-1)      # retention
+        w.i32(1)
+        w.string(topic)
+        w.i32(1)
+        w.i32(partition).i64(offset).string(None)
+        r = self._coordinator_request(group, 8, 2, bytes(w.buf))
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                err = r.i16()
+                if err:
+                    raise KafkaProtocolError(f"offset_commit error code {err}")
+
+    def offset_fetch(self, group: str, topic: str, partition: int) -> Optional[int]:
+        w = Writer()
+        w.string(group)
+        w.i32(1)
+        w.string(topic)
+        w.i32(1)
+        w.i32(partition)
+        r = self._coordinator_request(group, 9, 1, bytes(w.buf))
+        result: Optional[int] = None
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                off = r.i64()
+                r.string()  # metadata
+                err = r.i16()
+                if err:
+                    raise KafkaProtocolError(f"offset_fetch error code {err}")
+                result = None if off < 0 else off
+        return result
+
+
+# ---- MemoryBroker-surface adapter -------------------------------------------
+
+
+class KafkaWireBroker:
+    """Real-Kafka backend with the MemoryBroker surface, so BrokerSpout /
+    BrokerSink work unchanged (``BrokerConfig.kind='kafka'``)."""
+
+    #: BrokerSpout runs fetches through a worker thread when this is set
+    #: (network calls must not block the event loop).
+    blocking = True
+
+    def __init__(self, bootstrap: str, client_id: str = "storm-tpu") -> None:
+        self.client = KafkaWireClient(bootstrap, client_id)
+        self._rr = 0
+
+    def partitions_for(self, topic: str) -> int:
+        return self.client.partitions_for(topic)
+
+    def produce(self, topic, value, key=None, partition=None):
+        if isinstance(value, str):
+            value = value.encode("utf-8")
+        if isinstance(key, str):
+            key = key.encode("utf-8")
+        n = self.partitions_for(topic)
+        if partition is None:
+            if key is not None:
+                # Stable across processes (Python's hash() is seed-randomized
+                # per run; a durable Kafka log outlives the seed, so keyed
+                # ordering must use a deterministic hash).
+                partition = zlib.crc32(key) % n
+            else:
+                partition = self._rr % n
+                self._rr += 1
+        off = self.client.produce(topic, partition, [(key, value)])
+        return partition, off
+
+    def fetch(self, topic, partition, offset, max_records=512):
+        recs = self.client.fetch(topic, partition, offset)
+        return recs[:max_records]
+
+    def earliest_offset(self, topic, partition):
+        return self.client.list_offset(topic, partition, -2)
+
+    def latest_offset(self, topic, partition):
+        return self.client.list_offset(topic, partition, -1)
+
+    def commit(self, group, topic, partition, offset):
+        self.client.offset_commit(group, topic, partition, offset)
+
+    def committed(self, group, topic, partition):
+        return self.client.offset_fetch(group, topic, partition)
+
+    def close(self) -> None:
+        self.client.close()

@@ -61,11 +61,19 @@ class Producer:
 
 
 class MemoryProducer(Producer):
+    """Produces into any broker with the MemoryBroker surface; brokers
+    flagged ``blocking`` (network-backed, e.g. KafkaWireBroker) are called
+    on a worker thread to keep the event loop free."""
+
     def __init__(self, broker: MemoryBroker) -> None:
         self.broker = broker
+        self._blocking = bool(getattr(broker, "blocking", False))
 
     async def send(self, topic: str, value: bytes, key: Optional[bytes]) -> None:
-        self.broker.produce(topic, value, key)
+        if self._blocking:
+            await asyncio.to_thread(self.broker.produce, topic, value, key)
+        else:
+            self.broker.produce(topic, value, key)
 
 
 class BrokerSink(Bolt):

@@ -22,7 +22,9 @@ Kafka's consumer-group assignment across the reference's 2 spout executors.
 
 from __future__ import annotations
 
+import asyncio
 import collections
+import threading
 import time
 import uuid
 from typing import Any, Deque, Dict, Optional, Tuple
@@ -54,6 +56,9 @@ class BrokerSpout(Spout):
     def open(self, context: TopologyContext, collector: OutputCollector) -> None:
         super().open(context, collector)
         cfg = self.offsets_cfg
+        # Network-backed brokers (KafkaWireBroker) set blocking=True: their
+        # fetches/commits run on worker threads, never on the event loop.
+        self._blocking = bool(getattr(self.broker, "blocking", False))
         # Random group per run mirrors the reference's UUID consumer id
         # (MainTopology.java:98-99) unless the user pins one for resume.
         self.group = cfg.group_id or f"storm-tpu-{uuid.uuid4()}"
@@ -66,6 +71,13 @@ class BrokerSpout(Spout):
         self.replay: Deque[Record] = collections.deque()
         self.dropped = 0
         self._rr = 0
+        # Blocking-broker machinery: strong refs to background tasks (asyncio
+        # holds tasks weakly), per-partition committed high-water marks (so
+        # commits are monotonic without a network read), and a lock making
+        # check+commit atomic across worker threads.
+        self._bg: set = set()
+        self._commit_hwm: Dict[int, int] = {}
+        self._commit_lock = threading.Lock()
         for p in self.my_partitions:
             if cfg.policy == "latest":
                 pos = self.broker.latest_offset(self.topic, p)
@@ -100,7 +112,12 @@ class BrokerSpout(Spout):
             p = self.my_partitions[self._rr % len(self.my_partitions)]
             self._rr += 1
             pos = self.positions[p]
-            records = self.broker.fetch(self.topic, p, pos, self.fetch_size)
+            if self._blocking:
+                records = await asyncio.to_thread(
+                    self.broker.fetch, self.topic, p, pos, self.fetch_size
+                )
+            else:
+                records = self.broker.fetch(self.topic, p, pos, self.fetch_size)
             if not records:
                 continue
             emitted = 0
@@ -131,22 +148,59 @@ class BrokerSpout(Spout):
             open_offs = [o for (pp, o) in self.pending if pp == p]
             open_offs += [r.offset for r in self.replay if r.partition == p]
             low = min(open_offs) if open_offs else off + 1
-            prev = self.broker.committed(self.group, self.topic, p)
-            if prev is None or low > prev:
-                self.broker.commit(self.group, self.topic, p, low)
+            if self._blocking:
+                # Commit off-loop; ack() runs in ledger-callback (sync)
+                # context. Strong ref kept in _bg (create_task results are
+                # weakly referenced and could be GC'd before running).
+                self._spawn_bg(asyncio.to_thread(self._commit_blocking, p, low))
+            else:
+                prev = self.broker.committed(self.group, self.topic, p)
+                if prev is None or low > prev:
+                    self.broker.commit(self.group, self.topic, p, low)
+
+    def _spawn_bg(self, coro) -> None:
+        task = asyncio.get_event_loop().create_task(coro)
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
+
+    def _commit_blocking(self, p: int, low: int) -> None:
+        # The lock makes check+commit atomic across to_thread workers, and
+        # the local high-water mark keeps the committed offset monotonic
+        # (two racing commits must never regress the group offset).
+        with self._commit_lock:
+            hwm = self._commit_hwm.get(p, -1)
+            if low <= hwm:
+                return
+            self.broker.commit(self.group, self.topic, p, low)
+            self._commit_hwm[p] = low
 
     def fail(self, msg_id: Any) -> None:
         rec = self.pending.pop(msg_id, None)
         if rec is None:
             return
         max_behind = self.offsets_cfg.max_behind
-        if max_behind is not None:
-            latest = self.broker.latest_offset(self.topic, rec.partition)
-            if latest - rec.offset > max_behind:
-                # Too stale to replay under the freshness policy.
-                self.dropped += 1
-                self.context.metrics.counter(
-                    self.context.component_id, "dropped_stale"
-                ).inc()
-                return
+        if max_behind is None:
+            self.replay.append(rec)
+            return
+        if self._blocking:
+            # The staleness check is a network round-trip; fail() runs in
+            # sync ledger-callback context on the loop, so decide off-loop.
+            self._spawn_bg(self._fail_check_blocking(rec, max_behind))
+            return
+        self._fail_decide(rec, self.broker.latest_offset(self.topic, rec.partition), max_behind)
+
+    async def _fail_check_blocking(self, rec: Record, max_behind: int) -> None:
+        latest = await asyncio.to_thread(
+            self.broker.latest_offset, self.topic, rec.partition
+        )
+        self._fail_decide(rec, latest, max_behind)
+
+    def _fail_decide(self, rec: Record, latest: int, max_behind: int) -> None:
+        if latest - rec.offset > max_behind:
+            # Too stale to replay under the freshness policy.
+            self.dropped += 1
+            self.context.metrics.counter(
+                self.context.component_id, "dropped_stale"
+            ).inc()
+            return
         self.replay.append(rec)

@@ -66,9 +66,10 @@ def _make_broker(cfg: Config):
 
         return MemoryBroker(default_partitions=cfg.broker.partitions)
     if cfg.broker.kind == "kafka":
-        from storm_tpu.connectors.kafka import KafkaClientBroker
+        # Pure-Python wire-protocol client — no client library required.
+        from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
 
-        return KafkaClientBroker(cfg.broker.bootstrap)
+        return KafkaWireBroker(cfg.broker.bootstrap)
     raise ValueError(f"unknown broker kind {cfg.broker.kind!r}")
 
 

@@ -1,0 +1,117 @@
+"""Latency-driven autoscaler: the reference's scaling thesis, automated.
+
+The reference README's central claim (README.md:13-14) is: when input rate
+rises and latency grows, scale out the inference bolts to bring it back
+down — but in the reference that means editing a compile-time constant and
+rebuilding (MainTopology.java:27). Here it is a closed loop: watch the
+sink's end-to-end latency and the operator's inbox depth, and call the
+runtime's live ``rebalance`` (SURVEY.md §2.4 elastic row).
+
+Policy (deliberately simple and hysteretic):
+- scale UP one step when p50 latency exceeds ``high_ms`` or any inbox is
+  more than half full for two consecutive checks;
+- scale DOWN one step when p50 latency is under ``low_ms`` AND inboxes are
+  near-empty for ``cooldown`` consecutive checks;
+- bounded by [min_parallelism, max_parallelism]; one step per interval.
+
+On a TPU mesh, operator parallelism is pipelining depth (the mesh itself is
+the data parallelism), so steps are cheap: no model reload — executors share
+the engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+log = logging.getLogger("storm_tpu.autoscale")
+
+
+@dataclass
+class AutoscalePolicy:
+    component: str = "inference-bolt"
+    latency_source: str = "kafka-bolt"  # component whose e2e histogram we watch
+    high_ms: float = 200.0
+    low_ms: float = 50.0
+    min_parallelism: int = 1
+    max_parallelism: int = 16
+    interval_s: float = 5.0
+    cooldown: int = 3  # consecutive calm checks before scaling down
+
+
+class Autoscaler:
+    def __init__(self, runtime, policy: Optional[AutoscalePolicy] = None) -> None:
+        self.rt = runtime
+        self.policy = policy or AutoscalePolicy()
+        self._task: Optional[asyncio.Task] = None
+        self._calm = 0
+        self._hot = 0
+        self.decisions: list = []
+
+    def start(self) -> "Autoscaler":
+        self._task = asyncio.get_event_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    # ---- the control loop ----------------------------------------------------
+
+    async def _loop(self) -> None:
+        p = self.policy
+        while True:
+            await asyncio.sleep(p.interval_s)
+            try:
+                await self.step()
+            except Exception as e:  # pragma: no cover
+                log.warning("autoscale step failed: %s", e)
+
+    async def step(self) -> Optional[int]:
+        """One evaluation; returns the new parallelism if changed."""
+        p = self.policy
+        current = self.rt.parallelism_of(p.component)
+        lat = self.rt.metrics.histogram(p.latency_source, "e2e_latency_ms")
+        p50 = lat.percentile(50) if lat.count else None
+        execs = self.rt.bolt_execs.get(p.component, [])
+        inbox_frac = max(
+            (e.inbox.qsize() / max(1, e.inbox.maxsize) for e in execs), default=0.0
+        )
+
+        hot = (p50 is not None and p50 > p.high_ms) or inbox_frac > 0.5
+        calm = (p50 is None or p50 < p.low_ms) and inbox_frac < 0.05
+
+        if hot:
+            self._hot += 1
+            self._calm = 0
+        elif calm:
+            self._calm += 1
+            self._hot = 0
+        else:
+            self._hot = 0
+            self._calm = 0
+
+        if self._hot >= 2 and current < p.max_parallelism:
+            new = current + 1
+            log.info(
+                "scaling %s UP %d->%d (p50=%s ms, inbox=%.0f%%)",
+                p.component, current, new, p50, inbox_frac * 100,
+            )
+            await self.rt.rebalance(p.component, new)
+            self.decisions.append(("up", current, new))
+            self._hot = 0
+            return new
+        if self._calm >= p.cooldown and current > p.min_parallelism:
+            new = current - 1
+            log.info("scaling %s DOWN %d->%d (p50=%s ms)", p.component, current, new, p50)
+            await self.rt.rebalance(p.component, new)
+            self.decisions.append(("down", current, new))
+            self._calm = 0
+            return new
+        return None

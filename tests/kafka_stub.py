@@ -1,0 +1,269 @@
+"""In-process Kafka broker stub speaking the real wire protocol over real
+sockets — the test double for KafkaWireClient/KafkaWireBroker (SURVEY.md §4:
+fake broker for topology tests without external Kafka).
+
+Implements the exact API subset the client uses: Metadata v0, Produce v2,
+Fetch v2, ListOffsets v0, FindCoordinator v0, OffsetCommit v2,
+OffsetFetch v1. Single-node, message-format v1, no compression."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from storm_tpu.connectors.kafka_protocol import (
+    Reader,
+    Writer,
+    decode_message_set,
+    encode_message_set,
+)
+
+
+class KafkaStubBroker:
+    def __init__(self, partitions: int = 2) -> None:
+        self.partitions = partitions
+        self._logs: Dict[Tuple[str, int], List[Tuple[Optional[bytes], bytes, float]]] = {}
+        self._topics: Dict[str, int] = {}
+        self._commits: Dict[Tuple[str, str, int], int] = {}
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._running = True
+        self._threads: List[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # ---- plumbing ------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                head = self._recv(conn, 4)
+                if head is None:
+                    return
+                size = struct.unpack(">i", head)[0]
+                data = self._recv(conn, size)
+                if data is None:
+                    return
+                r = Reader(data)
+                api_key = r.i16()
+                api_version = r.i16()
+                corr = r.i32()
+                r.string()  # client id
+                body = self._dispatch(api_key, api_version, r)
+                resp = struct.pack(">i", corr) + body
+                conn.sendall(struct.pack(">i", len(resp)) + resp)
+        except (OSError, Exception):
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = bytearray()
+        while len(buf) < n:
+            c = conn.recv(n - len(buf))
+            if not c:
+                return None
+            buf += c
+        return bytes(buf)
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ---- state helpers -------------------------------------------------------
+
+    def _ensure(self, topic: str) -> None:
+        if topic not in self._topics:
+            self._topics[topic] = self.partitions
+            for p in range(self.partitions):
+                self._logs[(topic, p)] = []
+
+    def topic_size(self, topic: str) -> int:
+        with self._lock:
+            self._ensure(topic)
+            return sum(len(self._logs[(topic, p)]) for p in range(self.partitions))
+
+    # ---- api dispatch --------------------------------------------------------
+
+    def _dispatch(self, api: int, version: int, r: Reader) -> bytes:
+        if api == 3:
+            return self._metadata(r)
+        if api == 0:
+            return self._produce(r)
+        if api == 1:
+            return self._fetch(r)
+        if api == 2:
+            return self._list_offsets(r)
+        if api == 10:
+            return self._find_coordinator(r)
+        if api == 8:
+            return self._offset_commit(r)
+        if api == 9:
+            return self._offset_fetch(r)
+        raise RuntimeError(f"stub does not implement api {api}")
+
+    def _metadata(self, r: Reader) -> bytes:
+        n = r.i32()
+        topics = [r.string() for _ in range(n)]
+        with self._lock:
+            for t in topics:
+                self._ensure(t)
+            listing = {t: self._topics[t] for t in (topics or self._topics)}
+        w = Writer()
+        w.i32(1)  # one broker
+        w.i32(0).string("127.0.0.1").i32(self.port)
+        w.i32(len(listing))
+        for t, nparts in listing.items():
+            w.i16(0).string(t)
+            w.i32(nparts)
+            for p in range(nparts):
+                w.i16(0).i32(p).i32(0)  # leader node 0
+                w.i32(1).i32(0)  # replicas
+                w.i32(1).i32(0)  # isr
+        return bytes(w.buf)
+
+    def _produce(self, r: Reader) -> bytes:
+        r.i16()  # acks
+        r.i32()  # timeout
+        w = Writer()
+        n_topics = r.i32()
+        w.i32(n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            w.string(topic)
+            n_parts = r.i32()
+            w.i32(n_parts)
+            for _ in range(n_parts):
+                pid = r.i32()
+                data = r.bytes_() or b""
+                records = decode_message_set(topic, pid, data)
+                with self._lock:
+                    self._ensure(topic)
+                    log = self._logs[(topic, pid)]
+                    base = len(log)
+                    for rec in records:
+                        log.append((rec.key, rec.value, time.time()))
+                w.i32(pid).i16(0).i64(base).i64(-1)
+        w.i32(0)  # throttle
+        return bytes(w.buf)
+
+    def _fetch(self, r: Reader) -> bytes:
+        r.i32()  # replica
+        r.i32()  # max wait
+        r.i32()  # min bytes
+        w = Writer()
+        w.i32(0)  # throttle
+        n_topics = r.i32()
+        w.i32(n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            w.string(topic)
+            n_parts = r.i32()
+            w.i32(n_parts)
+            for _ in range(n_parts):
+                pid = r.i32()
+                offset = r.i64()
+                r.i32()  # max bytes
+                with self._lock:
+                    self._ensure(topic)
+                    log = self._logs[(topic, pid)]
+                    chunk = log[offset : offset + 256]
+                    hw = len(log)
+                msgset = encode_message_set(
+                    [(k, v) for k, v, _ in chunk],
+                    int(time.time() * 1e3),
+                    offsets=list(range(offset, offset + len(chunk))),
+                )
+                w.i32(pid).i16(0).i64(hw)
+                w.bytes_(msgset)
+        return bytes(w.buf)
+
+    def _list_offsets(self, r: Reader) -> bytes:
+        r.i32()  # replica
+        w = Writer()
+        n_topics = r.i32()
+        w.i32(n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            w.string(topic)
+            n_parts = r.i32()
+            w.i32(n_parts)
+            for _ in range(n_parts):
+                pid = r.i32()
+                ts = r.i64()
+                r.i32()  # max offsets
+                with self._lock:
+                    self._ensure(topic)
+                    end = len(self._logs[(topic, pid)])
+                off = 0 if ts == -2 else end
+                w.i32(pid).i16(0)
+                w.i32(1).i64(off)
+        return bytes(w.buf)
+
+    def _find_coordinator(self, r: Reader) -> bytes:
+        r.string()  # group
+        w = Writer()
+        w.i16(0)
+        w.i32(0).string("127.0.0.1").i32(self.port)
+        return bytes(w.buf)
+
+    def _offset_commit(self, r: Reader) -> bytes:
+        group = r.string()
+        r.i32()  # generation
+        r.string()  # member
+        r.i64()  # retention
+        w = Writer()
+        n_topics = r.i32()
+        w.i32(n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            w.string(topic)
+            n_parts = r.i32()
+            w.i32(n_parts)
+            for _ in range(n_parts):
+                pid = r.i32()
+                off = r.i64()
+                r.string()  # metadata
+                with self._lock:
+                    self._commits[(group, topic, pid)] = off
+                w.i32(pid).i16(0)
+        return bytes(w.buf)
+
+    def _offset_fetch(self, r: Reader) -> bytes:
+        group = r.string()
+        w = Writer()
+        n_topics = r.i32()
+        w.i32(n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            w.string(topic)
+            n_parts = r.i32()
+            w.i32(n_parts)
+            for _ in range(n_parts):
+                pid = r.i32()
+                with self._lock:
+                    off = self._commits.get((group, topic, pid), -1)
+                w.i32(pid).i64(off).string(None).i16(0)
+        return bytes(w.buf)

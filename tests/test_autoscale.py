@@ -1,0 +1,85 @@
+"""Autoscaler: hysteretic scale up on latency/backlog, scale down when calm."""
+
+import asyncio
+
+import pytest
+
+from storm_tpu.config import Config
+from storm_tpu.runtime import Bolt, TopologyBuilder
+from storm_tpu.runtime.autoscale import AutoscalePolicy, Autoscaler
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+
+class IdleBolt(Bolt):
+    async def execute(self, t):
+        self.collector.ack(t)
+
+
+async def _mk_runtime():
+    from tests.test_runtime import ListSpout
+
+    cluster = AsyncLocalCluster()
+    tb = TopologyBuilder()
+    tb.set_spout("s", ListSpout([]), 1)
+    tb.set_bolt("inference-bolt", IdleBolt(), 2).shuffle_grouping("s")
+    tb.set_bolt("kafka-bolt", IdleBolt(), 1).shuffle_grouping("inference-bolt")
+    rt = await cluster.submit("t", Config(), tb.build())
+    return cluster, rt
+
+
+def test_scales_up_on_high_latency(run):
+    async def go():
+        cluster, rt = await _mk_runtime()
+        scaler = Autoscaler(rt, AutoscalePolicy(high_ms=100, max_parallelism=4))
+        hist = rt.metrics.histogram("kafka-bolt", "e2e_latency_ms")
+        for _ in range(50):
+            hist.observe(500.0)  # hot
+        r1 = await scaler.step()  # hot #1
+        r2 = await scaler.step()  # hot #2 -> scale up
+        par = rt.parallelism_of("inference-bolt")
+        await cluster.shutdown()
+        return r1, r2, par
+
+    r1, r2, par = run(go())
+    assert r1 is None
+    assert r2 == 3
+    assert par == 3
+
+
+def test_scales_down_when_calm(run):
+    async def go():
+        cluster, rt = await _mk_runtime()
+        scaler = Autoscaler(
+            rt, AutoscalePolicy(low_ms=50, min_parallelism=1, cooldown=2)
+        )
+        hist = rt.metrics.histogram("kafka-bolt", "e2e_latency_ms")
+        for _ in range(50):
+            hist.observe(5.0)  # calm
+        r1 = await scaler.step()
+        r2 = await scaler.step()  # calm #2 -> scale down
+        par = rt.parallelism_of("inference-bolt")
+        await cluster.shutdown()
+        return r1, r2, par
+
+    r1, r2, par = run(go())
+    assert r1 is None and r2 == 1
+    assert par == 1
+
+
+def test_respects_bounds(run):
+    async def go():
+        cluster, rt = await _mk_runtime()
+        scaler = Autoscaler(
+            rt, AutoscalePolicy(high_ms=10, max_parallelism=2, min_parallelism=2)
+        )
+        hist = rt.metrics.histogram("kafka-bolt", "e2e_latency_ms")
+        for _ in range(10):
+            hist.observe(500.0)
+        results = [await scaler.step() for _ in range(4)]
+        par = rt.parallelism_of("inference-bolt")
+        await cluster.shutdown()
+        return results, par
+
+    results, par = run(go())
+    assert all(r is None for r in results)  # already at max=2
+    assert par == 2

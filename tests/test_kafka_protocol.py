@@ -1,0 +1,138 @@
+"""Kafka wire-protocol client tests against the in-process stub broker
+(real sockets, real encoding — the integration the reference only ever got
+by deploying to a live cluster, SURVEY.md §4)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from storm_tpu.config import Config, OffsetsConfig
+from storm_tpu.connectors.kafka_protocol import (
+    KafkaProtocolError,
+    KafkaWireBroker,
+    KafkaWireClient,
+    decode_message_set,
+    encode_message_set,
+)
+from tests.kafka_stub import KafkaStubBroker
+
+
+@pytest.fixture()
+def stub():
+    b = KafkaStubBroker(partitions=2)
+    yield b
+    b.close()
+
+
+@pytest.fixture()
+def client(stub):
+    c = KafkaWireClient(f"127.0.0.1:{stub.port}")
+    yield c
+    c.close()
+
+
+def test_message_set_roundtrip():
+    recs = [(b"k1", b"v1"), (None, b"v2")]
+    data = encode_message_set(recs, 1234567, offsets=[5, 6])
+    out = decode_message_set("t", 0, data)
+    assert [(r.key, r.value, r.offset) for r in out] == [
+        (b"k1", b"v1", 5), (None, b"v2", 6)
+    ]
+
+
+def test_metadata_and_partitions(client):
+    assert client.partitions_for("topic-a") == 2
+
+
+def test_produce_fetch_roundtrip(client):
+    base = client.produce("t", 0, [(None, b"hello"), (b"k", b"world")])
+    assert base == 0
+    recs = client.fetch("t", 0, 0)
+    assert [r.value for r in recs] == [b"hello", b"world"]
+    assert recs[1].key == b"k"
+    # fetch from mid-offset
+    recs2 = client.fetch("t", 0, 1)
+    assert [r.value for r in recs2] == [b"world"]
+
+
+def test_list_offsets(client):
+    assert client.list_offset("t2", 0, -1) == 0
+    client.produce("t2", 0, [(None, b"x")] * 3)
+    assert client.list_offset("t2", 0, -1) == 3
+    assert client.list_offset("t2", 0, -2) == 0
+
+
+def test_offset_commit_fetch(client):
+    assert client.offset_fetch("g1", "t3", 0) is None
+    client.offset_commit("g1", "t3", 0, 42)
+    assert client.offset_fetch("g1", "t3", 0) == 42
+
+
+def test_wire_broker_surface(stub):
+    broker = KafkaWireBroker(f"127.0.0.1:{stub.port}")
+    p, off = broker.produce("t4", "payload-1")
+    assert off == 0
+    assert broker.latest_offset("t4", p) == 1
+    recs = broker.fetch("t4", p, 0)
+    assert recs[0].value == b"payload-1"
+    broker.commit("g", "t4", p, 1)
+    assert broker.committed("g", "t4", p) == 1
+    broker.close()
+
+
+def test_wire_broker_key_affinity(stub):
+    broker = KafkaWireBroker(f"127.0.0.1:{stub.port}")
+    parts = {broker.produce("t5", f"v{i}", key="samekey")[0] for i in range(5)}
+    assert len(parts) == 1
+    broker.close()
+
+
+def test_end_to_end_topology_over_sockets(stub, run):
+    """Full streaming topology with ingress AND egress over the real wire
+    protocol: socket in -> spout -> bolt -> sink -> socket out."""
+    from tests.test_runtime import PassBolt
+    from storm_tpu.connectors import BrokerSink, BrokerSpout
+    from storm_tpu.connectors.sink import Producer
+    from storm_tpu.runtime import TopologyBuilder
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    broker = KafkaWireBroker(f"127.0.0.1:{stub.port}")
+
+    class WireProducer(Producer):
+        async def send(self, topic, value, key):
+            await asyncio.to_thread(broker.produce, topic, value, key)
+
+    class WireSink(BrokerSink):
+        def make_producer(self):
+            return WireProducer()
+
+    async def go():
+        cfg = Config()
+        tb = TopologyBuilder()
+        tb.set_spout(
+            "in",
+            BrokerSpout(broker, "wire-in", OffsetsConfig(policy="earliest", max_behind=None)),
+            2,
+        )
+        tb.set_bolt("mid", PassBolt(), 2).shuffle_grouping("in")
+        tb.set_bolt("out", WireSink(None, "wire-out", cfg.sink), 1).shuffle_grouping("mid")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("wire", cfg, tb.build())
+        for i in range(6):
+            broker.produce("wire-in", f"msg-{i}")
+        deadline = asyncio.get_event_loop().time() + 30
+        while asyncio.get_event_loop().time() < deadline:
+            if stub.topic_size("wire-out") >= 6:
+                break
+            await asyncio.sleep(0.05)
+        out = []
+        for p in range(2):
+            out.extend(broker.fetch("wire-out", p, 0, 100))
+        await cluster.shutdown()
+        return out
+
+    out = run(go(), timeout=60)
+    assert sorted(r.value.decode() for r in out) == [f"msg-{i}" for i in range(6)]
+    broker.close()
