@@ -45,7 +45,7 @@ CONFIGS = {
 }
 
 
-def build_topology(cfg, broker, batch_cfg):
+def build_topology(cfg, broker, batch_cfg, transfer_dtype=None):
     from storm_tpu.config import Config, ModelConfig, OffsetsConfig, ShardingConfig
     from storm_tpu.connectors import BrokerSink, BrokerSpout
     from storm_tpu.infer import InferenceBolt
@@ -58,6 +58,7 @@ def build_topology(cfg, broker, batch_cfg):
         dtype="bfloat16",
         input_shape=cfg["input_shape"],
         num_classes=cfg["num_classes"],
+        transfer_dtype=transfer_dtype,
     )
     tb = TopologyBuilder()
     tb.set_spout(
@@ -96,6 +97,9 @@ def main() -> None:
     ap.add_argument("--latency-seconds", type=float, default=8.0)
     ap.add_argument("--max-wait-ms", type=float, default=25.0)
     ap.add_argument("--max-batch", type=int, default=0, help="override config max_batch")
+    ap.add_argument("--transfer-dtype", default=None, choices=["uint8"],
+                    help="quantize the host->device wire to uint8 (4x fewer "
+                         "bytes than f32 over the link; lossy, opt-in)")
     ap.add_argument("--skip-latency", action="store_true")
     args = ap.parse_args()
     cfg = CONFIGS[args.config]
@@ -118,7 +122,7 @@ def main() -> None:
         buckets=cfg["buckets"],
     )
     broker = MemoryBroker(default_partitions=4)
-    run_cfg, topo = build_topology(cfg, broker, batch_cfg)
+    run_cfg, topo = build_topology(cfg, broker, batch_cfg, args.transfer_dtype)
     t0 = time.time()
     cluster.submit_topology("bench-throughput", run_cfg, topo)
     log(f"submitted + warmed up in {time.time() - t0:.1f}s")
@@ -166,7 +170,7 @@ def main() -> None:
             buckets=cfg["buckets"],
         )
         broker2 = MemoryBroker(default_partitions=4)
-        run_cfg2, topo2 = build_topology(cfg, broker2, lat_batch_cfg)
+        run_cfg2, topo2 = build_topology(cfg, broker2, lat_batch_cfg, args.transfer_dtype)
         cluster.submit_topology("bench-latency", run_cfg2, topo2)
         # Offer well below saturation: the latency topology uses the short
         # deadline (small batches), so its capacity is below the

@@ -64,6 +64,16 @@ class ModelConfig:
     num_classes: int = 10
     input_shape: tuple = (28, 28, 1)  # per-instance HWC
     seed: int = 0
+    # Wire dtype for the host->device transfer. None ships the compute dtype
+    # (bf16 = half the bytes of f32); "uint8" affine-quantizes per batch on
+    # the host and dequantizes on device inside the jit program — 4x fewer
+    # bytes than f32 over the PCIe/tunnel link, which is the streaming
+    # bottleneck (BENCH_NOTES.md). Lossy (8-bit) and therefore opt-in.
+    transfer_dtype: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.transfer_dtype not in (None, "uint8"):
+            raise ValueError(f"unsupported transfer_dtype {self.transfer_dtype!r}")
 
 
 @dataclass

@@ -230,6 +230,7 @@ class KafkaWireClient:
         self.client_id = client_id
         self.timeout = timeout
         self._conns: Dict[Tuple[str, int], _Conn] = {}
+        self._conn_locks: Dict[Tuple[str, int], threading.Lock] = {}
         self._brokers: Dict[int, Tuple[str, int]] = {}
         self._meta: Dict[str, Dict[int, _PartitionMeta]] = {}
         self._coordinators: Dict[str, Tuple[str, int]] = {}
@@ -238,10 +239,23 @@ class KafkaWireClient:
     # -- connections ----------------------------------------------------------
 
     def _conn(self, addr: Tuple[str, int]) -> _Conn:
+        """Cached connection per broker address.
+
+        The blocking TCP connect happens under a *per-address* lock, never the
+        client-wide one — a dead broker's connect timeout must not stall
+        cache hits for healthy brokers on other threads."""
         with self._lock:
             c = self._conns.get(addr)
-            if c is None:
-                c = _Conn(addr[0], addr[1], self.client_id, self.timeout)
+            if c is not None:
+                return c
+            addr_lock = self._conn_locks.setdefault(addr, threading.Lock())
+        with addr_lock:
+            with self._lock:
+                c = self._conns.get(addr)
+                if c is not None:
+                    return c
+            c = _Conn(addr[0], addr[1], self.client_id, self.timeout)
+            with self._lock:
                 self._conns[addr] = c
             return c
 
@@ -513,6 +527,12 @@ class KafkaWireBroker:
     def __init__(self, bootstrap: str, client_id: str = "storm-tpu") -> None:
         self.client = KafkaWireClient(bootstrap, client_id)
         self._rr = 0
+        # Decoded-but-not-yet-returned tail of the last wire fetch, per
+        # partition: a 1MB fetch can decode far more than max_records, and
+        # re-fetching the discarded tail on every poll is quadratic during
+        # backlog catch-up. Each partition is polled serially by its owning
+        # spout task, matching this cache's consistency model.
+        self._prefetch: Dict[Tuple[str, int], List[Record]] = {}
 
     def partitions_for(self, topic: str) -> int:
         return self.client.partitions_for(topic)
@@ -536,7 +556,15 @@ class KafkaWireBroker:
         return partition, off
 
     def fetch(self, topic, partition, offset, max_records=512):
+        key = (topic, partition)
+        buf = self._prefetch.pop(key, None)
+        if buf and buf[0].offset == offset:
+            if len(buf) > max_records:
+                self._prefetch[key] = buf[max_records:]
+            return buf[:max_records]
         recs = self.client.fetch(topic, partition, offset)
+        if len(recs) > max_records:
+            self._prefetch[key] = recs[max_records:]
         return recs[:max_records]
 
     def earliest_offset(self, topic, partition):

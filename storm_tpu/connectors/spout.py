@@ -178,29 +178,41 @@ class BrokerSpout(Spout):
         rec = self.pending.pop(msg_id, None)
         if rec is None:
             return
+        # Queue for replay FIRST, unconditionally: between here and a (possibly
+        # asynchronous) staleness verdict the record must be visible to ack()'s
+        # low-water commit scan, or a concurrent ack on a later offset would
+        # commit past it and a restart would skip it. Staleness then *removes*
+        # it — the conservative direction for at-least-once.
+        self.replay.append(rec)
         max_behind = self.offsets_cfg.max_behind
         if max_behind is None:
-            self.replay.append(rec)
             return
         if self._blocking:
             # The staleness check is a network round-trip; fail() runs in
             # sync ledger-callback context on the loop, so decide off-loop.
             self._spawn_bg(self._fail_check_blocking(rec, max_behind))
             return
-        self._fail_decide(rec, self.broker.latest_offset(self.topic, rec.partition), max_behind)
+        self._drop_if_stale(rec, self.broker.latest_offset(self.topic, rec.partition), max_behind)
 
     async def _fail_check_blocking(self, rec: Record, max_behind: int) -> None:
-        latest = await asyncio.to_thread(
-            self.broker.latest_offset, self.topic, rec.partition
-        )
-        self._fail_decide(rec, latest, max_behind)
+        try:
+            latest = await asyncio.to_thread(
+                self.broker.latest_offset, self.topic, rec.partition
+            )
+        except Exception:
+            # Broker unreachable: leave the record queued for replay rather
+            # than guessing staleness — losing it would break at-least-once.
+            return
+        self._drop_if_stale(rec, latest, max_behind)
 
-    def _fail_decide(self, rec: Record, latest: int, max_behind: int) -> None:
+    def _drop_if_stale(self, rec: Record, latest: int, max_behind: int) -> None:
         if latest - rec.offset > max_behind:
+            try:
+                self.replay.remove(rec)
+            except ValueError:
+                return  # already picked up for replay — let it ride
             # Too stale to replay under the freshness policy.
             self.dropped += 1
             self.context.metrics.counter(
                 self.context.component_id, "dropped_stale"
             ).inc()
-            return
-        self.replay.append(rec)

@@ -64,6 +64,7 @@ class InferenceEngine:
 
         apply = self.model.apply
         x_shard = batch_sharding(self.mesh, self.data_axis)
+        dtype = self.dtype
 
         def fwd(params, state, x):
             logits, _ = apply(params, state, x, train=False)
@@ -75,7 +76,28 @@ class InferenceEngine:
             in_shardings=(replicated(self.mesh), replicated(self.mesh), x_shard),
             out_shardings=x_shard,
         )
+        # uint8 transfer path: the wire carries affine-quantized bytes plus a
+        # per-batch (scale, offset); dequantization runs on device inside the
+        # same jit program, so XLA fuses it into the first conv/matmul's input.
+        self._quantize = model_cfg.transfer_dtype == "uint8"
+
+        def fwd_q(params, state, xq, scale, offset):
+            x = (xq.astype(jnp.float32) * scale + offset).astype(dtype)
+            return fwd(params, state, x)
+
+        self._fwd_q = jax.jit(
+            fwd_q,
+            in_shardings=(
+                replicated(self.mesh),
+                replicated(self.mesh),
+                x_shard,
+                replicated(self.mesh),
+                replicated(self.mesh),
+            ),
+            out_shardings=x_shard,
+        )
         self._x_sharding = x_shard
+        self._scalar_sharding = replicated(self.mesh)
         self.compiled_batches: set = set()
 
     # ---- shape management ----------------------------------------------------
@@ -118,16 +140,28 @@ class InferenceEngine:
         """
         n = x.shape[0]
         padded = self.pad_batch(n)
+        if self._quantize:
+            # Range from the real rows only (padding would drag lo to 0).
+            lo = float(x.min())
+            hi = float(x.max())
+            scale = np.float32(max((hi - lo) / 255.0, 1e-12))
+            offset = np.float32(lo)
         if padded != n:
             x = np.concatenate([x, np.zeros((padded - n, *x.shape[1:]), x.dtype)])
-        # Cast on the HOST (ml_dtypes gives numpy a bfloat16) so the
-        # host->device transfer ships half the bytes — the tunnel/PCIe link
-        # is the streaming bottleneck, not the cast.
-        if x.dtype != self.dtype:
-            x = x.astype(self.dtype)
-        with self._lock:
-            xd = jax.device_put(x, self._x_sharding)
-            out = self._fwd(self.params, self.state, xd)
+        if self._quantize:
+            xw = np.clip(np.rint((x - offset) / scale), 0, 255).astype(np.uint8)
+            with self._lock:
+                xd = jax.device_put(xw, self._x_sharding)
+                out = self._fwd_q(self.params, self.state, xd, scale, offset)
+        else:
+            # Cast on the HOST (ml_dtypes gives numpy a bfloat16) so the
+            # host->device transfer ships half the bytes — the tunnel/PCIe
+            # link is the streaming bottleneck, not the cast.
+            if x.dtype != self.dtype:
+                x = x.astype(self.dtype)
+            with self._lock:
+                xd = jax.device_put(x, self._x_sharding)
+                out = self._fwd(self.params, self.state, xd)
         self.compiled_batches.add(padded)
         return np.asarray(out)[:n]
 
@@ -149,6 +183,7 @@ def shared_engine(
     key = (
         model_cfg.name,
         model_cfg.dtype,
+        model_cfg.transfer_dtype,
         tuple(model_cfg.input_shape),
         model_cfg.num_classes,
         model_cfg.checkpoint,

@@ -41,7 +41,9 @@ struct Parser {
 
   explicit Parser(const char* buf, size_t len) : p(buf), end(buf + len) {
     for (int64_t& s : shape) s = -1;
-    out.reserve(1024);
+    // ~7 bytes per "0.1234," literal: one reserve sized off the payload
+    // avoids every growth-realloc copy of the output buffer.
+    out.reserve(len / 6 + 16);
   }
 
   void skip_ws() {
@@ -111,13 +113,86 @@ struct Parser {
     return true;
   }
 
-  bool parse_number() {
-    skip_ws();
-    double v;
-    auto res = std::from_chars(p, end, v);
-    if (res.ec != std::errc()) return fail("instances contains a non-numeric leaf");
-    p = res.ptr;
-    out.push_back(static_cast<float>(v));
+  // Fixed-point decimal fast path: sign, <=15 digits, optional '.', no
+  // exponent — covers pixel/probability literals. The <=15-digit mantissa is
+  // exact in a uint64->double, and negative powers of ten up to 1e15 are
+  // exact doubles, so one double divide + one float cast is correctly
+  // rounded to within 1 ulp of from_chars (which remains the fallback).
+  bool parse_float_fast(float* out_v) {
+    static constexpr double kPow10[16] = {
+        1e0, 1e1, 1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+        1e8, 1e9, 1e10, 1e11, 1e12, 1e13, 1e14, 1e15};
+    const char* q = p;
+    bool neg = false;
+    if (q < end && *q == '-') {
+      neg = true;
+      ++q;
+    }
+    uint64_t mant = 0;
+    int digits = 0;
+    while (q < end && static_cast<unsigned>(*q - '0') <= 9) {
+      mant = mant * 10 + static_cast<unsigned>(*q - '0');
+      ++q;
+      ++digits;
+    }
+    int frac = 0;
+    if (q < end && *q == '.') {
+      ++q;
+      const char* fs = q;
+      while (q < end && static_cast<unsigned>(*q - '0') <= 9) {
+        mant = mant * 10 + static_cast<unsigned>(*q - '0');
+        ++q;
+      }
+      frac = static_cast<int>(q - fs);
+      digits += frac;
+    }
+    if (digits == 0 || digits > 15 || frac > 15) return false;
+    if (q < end && (*q == 'e' || *q == 'E')) return false;
+    double d = static_cast<double>(mant);
+    if (frac) d /= kPow10[frac];
+    *out_v = static_cast<float>(neg ? -d : d);
+    p = q;
+    return true;
+  }
+
+  // Tight loop for the innermost dimension: numbers only, no per-element
+  // recursion or depth checks — this is where ~all the bytes are.
+  bool parse_leaf_array(int depth, int64_t* count) {
+    int64_t n = 0;
+    while (true) {
+      skip_ws();
+      if (p >= end) return fail("unterminated array");
+      if (*p == '[') return fail("instances is ragged (mixed nesting depth)");
+      float v;
+      if (!parse_float_fast(&v)) {
+        auto res = std::from_chars(p, end, v);
+        if (res.ec != std::errc())
+          return fail("instances contains a non-numeric leaf");
+        p = res.ptr;
+      }
+      out.push_back(v);
+      ++n;
+      if (p < end && *p == ',') {
+        ++p;
+        continue;
+      }
+      skip_ws();
+      if (p < end && *p == ',') {
+        ++p;
+        continue;
+      }
+      if (p < end && *p == ']') {
+        ++p;
+        break;
+      }
+      return fail("expected ',' or ']' in array");
+    }
+    if (shape[depth] == -1) {
+      shape[depth] = n;
+    } else if (shape[depth] != n) {
+      return fail("instances is ragged (inconsistent lengths)");
+    }
+    *count = n;
     return true;
   }
 
@@ -130,6 +205,7 @@ struct Parser {
       ++p;
       return fail("instances has an empty dimension");
     }
+    if (depth == rank - 1) return parse_leaf_array(depth, count);
     int64_t n = 0;
     while (true) {
       skip_ws();
@@ -138,9 +214,7 @@ struct Parser {
         int64_t sub = 0;
         if (!parse_array(depth + 1, &sub)) return false;
       } else {
-        if (rank >= 0 && depth != rank - 1)
-          return fail("instances is ragged (mixed nesting depth)");
-        if (!parse_number()) return false;
+        return fail("instances is ragged (mixed nesting depth)");
       }
       ++n;
       skip_ws();

@@ -52,6 +52,10 @@ class OutputCollector:
         self.component_id = component_id
         self.task_index = task_index
         self._out_fields: Dict[str, Sequence[str]] = {"default": ("message",)}
+        # Per-tuple hot path: resolve the registry dicts once, not per call.
+        self._m_emitted = runtime.metrics.counter(component_id, "emitted")
+        self._m_acked = runtime.metrics.counter(component_id, "acked")
+        self._m_failed = runtime.metrics.counter(component_id, "failed")
 
     def set_output_fields(self, fields: Dict[str, Sequence[str]]) -> None:
         self._out_fields = fields
@@ -139,7 +143,7 @@ class OutputCollector:
             )
             await inbox.put(t)
             n += 1
-        self._rt.metrics.counter(self.component_id, "emitted").inc(n)
+        self._m_emitted.inc(n)
         return n
 
     # ---- acking --------------------------------------------------------------
@@ -148,13 +152,13 @@ class OutputCollector:
         """Mark the input tuple consumed (InferenceBolt.java:99)."""
         for r in t.anchors:
             self._rt.ledger.xor(r, t.edge_id)
-        self._rt.metrics.counter(self.component_id, "acked").inc()
+        self._m_acked.inc()
 
     def fail(self, t: Tuple) -> None:
         """Fail the input tuple's roots -> spout replay (KafkaBolt.java:137)."""
         for r in t.anchors:
             self._rt.ledger.fail_root(r)
-        self._rt.metrics.counter(self.component_id, "failed").inc()
+        self._m_failed.inc()
 
     def report_error(self, err: BaseException) -> None:
         self._rt.report_error(self.component_id, self.task_index, err)

@@ -196,3 +196,95 @@ def test_sink_null_topic_warns_and_acks(run):
     acked, failed = run(_sink_run(broker, sink, ["a"]))
     assert acked == ["a"] and failed == []
     assert broker.topic_size("out") == 0
+
+
+# ---- fail-path at-least-once invariants (blocking brokers) -------------------
+
+
+class _SlowLatestBroker(MemoryBroker):
+    """Blocking broker whose latest_offset waits on an event (simulating a
+    network round-trip) or raises (simulating broker downtime)."""
+
+    blocking = True
+
+    def __init__(self):
+        super().__init__(default_partitions=1)
+        self.gate = asyncio.Event()
+        self.raise_on_latest = False
+        self._loop = None
+
+    def latest_offset(self, topic, partition):
+        if self.raise_on_latest:
+            raise OSError("broker unreachable")
+        if self._loop is not None:
+            # Called from a to_thread worker: block until the test opens the gate.
+            import concurrent.futures
+            fut = asyncio.run_coroutine_threadsafe(self.gate.wait(), self._loop)
+            fut.result(timeout=5)
+        return super().latest_offset(topic, partition)
+
+
+def _make_failing_spout(broker):
+    """A BrokerSpout wired with the minimum context to exercise fail()."""
+    from storm_tpu.runtime.metrics import MetricsRegistry
+
+    spout = BrokerSpout(broker, "in", OffsetsConfig(policy="earliest", max_behind=0))
+
+    class Ctx:
+        parallelism = 1
+        task_index = 0
+        component_id = "spout"
+        metrics = MetricsRegistry()
+
+    class Coll:
+        async def emit(self, *a, **k):
+            return 1
+
+    spout.open(Ctx(), Coll())
+    return spout
+
+
+def test_blocking_fail_keeps_record_visible_during_staleness_check(run):
+    """While the async staleness check is in flight, the failed record must
+    already sit in `replay` so ack()'s low-water commit scan sees it — a
+    commit racing past an undecided failure would break at-least-once."""
+
+    async def body():
+        broker = _SlowLatestBroker()
+        broker.produce("in", "v0")
+        spout = _make_failing_spout(broker)
+        broker._loop = asyncio.get_running_loop()
+        rec = broker.fetch("in", 0, 0, 10)[0]
+        spout.pending[(0, rec.offset)] = rec
+        spout.fail((0, rec.offset))
+        # Verdict still pending (gate closed): record must be in replay NOW.
+        assert rec in spout.replay
+        broker.produce("in", "fresh")  # makes offset 0 stale (max_behind=0)
+        broker.gate.set()
+        for _ in range(100):
+            if rec not in spout.replay:
+                break
+            await asyncio.sleep(0.01)
+        assert rec not in spout.replay  # stale verdict removed it
+        assert spout.dropped == 1
+
+    run(body())
+
+
+def test_blocking_fail_broker_error_keeps_record_for_replay(run):
+    """If the staleness probe raises (broker down), the record must stay
+    queued for replay — never silently dropped."""
+
+    async def body():
+        broker = _SlowLatestBroker()
+        broker.produce("in", "v0")
+        spout = _make_failing_spout(broker)
+        broker.raise_on_latest = True
+        rec = broker.fetch("in", 0, 0, 10)[0]
+        spout.pending[(0, rec.offset)] = rec
+        spout.fail((0, rec.offset))
+        await asyncio.sleep(0.05)  # let the background check run and raise
+        assert rec in spout.replay
+        assert spout.dropped == 0
+
+    run(body())

@@ -129,3 +129,48 @@ def test_engine_bf16_path():
     out = eng.predict(np.random.randn(2, 28, 28, 1).astype(np.float32))
     assert out.dtype == np.float32  # probabilities come back f32
     np.testing.assert_allclose(out.sum(-1), np.ones(2), atol=1e-2)
+
+
+def test_engine_uint8_transfer_matches_f32():
+    """uint8 wire quantization (ModelConfig.transfer_dtype) must stay close to
+    the full-precision path: inputs cross the link as 1 byte/elem + a per-batch
+    (scale, offset), dequantized on device inside the jit program."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(6, 28, 28, 1).astype(np.float32)  # pixel-like [0, 1)
+    f32 = InferenceEngine(
+        ModelConfig(name="lenet5", dtype="float32", input_shape=(28, 28, 1)),
+        ShardingConfig(data_parallel=1),
+        BatchConfig(max_batch=8, buckets=(8,)),
+    )
+    q8 = InferenceEngine(
+        ModelConfig(
+            name="lenet5", dtype="float32", input_shape=(28, 28, 1),
+            transfer_dtype="uint8",
+        ),
+        ShardingConfig(data_parallel=1),
+        BatchConfig(max_batch=8, buckets=(8,)),
+    )
+    want = f32.predict(x)
+    got = q8.predict(x)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got.sum(-1), np.ones(6), atol=1e-4)
+    np.testing.assert_allclose(got, want, atol=0.02)
+
+
+def test_engine_uint8_constant_input_no_nan():
+    """Degenerate range (hi == lo) must not divide by zero."""
+    eng = InferenceEngine(
+        ModelConfig(
+            name="lenet5", dtype="float32", input_shape=(28, 28, 1),
+            transfer_dtype="uint8",
+        ),
+        ShardingConfig(data_parallel=1),
+        BatchConfig(max_batch=8, buckets=(8,)),
+    )
+    out = eng.predict(np.full((2, 28, 28, 1), 0.5, np.float32))
+    assert np.isfinite(out).all()
+
+
+def test_model_config_rejects_bad_transfer_dtype():
+    with pytest.raises(ValueError):
+        ModelConfig(transfer_dtype="int4")

@@ -136,3 +136,24 @@ def test_end_to_end_topology_over_sockets(stub, run):
     out = run(go(), timeout=60)
     assert sorted(r.value.decode() for r in out) == [f"msg-{i}" for i in range(6)]
     broker.close()
+
+
+def test_wire_broker_fetch_buffers_remainder(stub):
+    """A wire fetch decoding more than max_records must buffer the tail and
+    serve it on the next poll instead of re-fetching the same bytes."""
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+    b = KafkaWireBroker(f"127.0.0.1:{stub.port}")
+    try:
+        for i in range(20):
+            b.produce("bulk", f"m{i}", partition=0)
+        first = b.fetch("bulk", 0, 0, max_records=5)
+        assert [r.offset for r in first] == [0, 1, 2, 3, 4]
+        assert ("bulk", 0) in b._prefetch
+        second = b.fetch("bulk", 0, 5, max_records=5)
+        assert [r.offset for r in second] == [5, 6, 7, 8, 9]
+        # A seek (offset mismatch) invalidates the buffer instead of serving it.
+        seek = b.fetch("bulk", 0, 12, max_records=5)
+        assert [r.offset for r in seek][0] == 12
+    finally:
+        b.close()
