@@ -42,7 +42,159 @@ CONFIGS = {
                      bolts=4, max_batch=64, buckets=(16, 64), metric="imagenet_resnet50"),
     "vit_b16": dict(model="vit_b16", input_shape=(224, 224, 3), num_classes=1000,
                     bolts=4, max_batch=64, buckets=(16, 64), metric="imagenet_vit_b16"),
+    # BASELINE.json config 5: MNIST+CIFAR pipelines sharing one slice.
+    # Dispatches to run_multi() — the dict here only carries the metric name.
+    "multi": dict(metric="multi_mnist_cifar"),
 }
+
+
+MULTI_MODELS = {
+    "mnist": dict(model="lenet5", input_shape=(28, 28, 1), num_classes=10,
+                  bolts=2, max_batch=512, buckets=(64, 512)),
+    "cifar": dict(model="resnet20", input_shape=(32, 32, 3), num_classes=10,
+                  bolts=2, max_batch=512, buckets=(64, 512)),
+}
+
+
+def build_multi_topology(broker, max_wait_ms, transfer_dtype=None, max_batch=0):
+    from storm_tpu.config import (
+        BatchConfig, Config, ModelConfig, OffsetsConfig, PipelineConfig, ShardingConfig,
+    )
+    from storm_tpu.main import build_multi_model_topology
+
+    run_cfg = Config()
+    run_cfg.topology.message_timeout_s = 300.0
+    run_cfg.pipelines = [
+        PipelineConfig(
+            name=name,
+            model=ModelConfig(
+                name=mc["model"], dtype="bfloat16", input_shape=mc["input_shape"],
+                num_classes=mc["num_classes"], transfer_dtype=transfer_dtype,
+            ),
+            batch=BatchConfig(max_batch=max_batch or mc["max_batch"],
+                              max_wait_ms=max_wait_ms,
+                              buckets=(max_batch,) if max_batch else mc["buckets"]),
+            sharding=ShardingConfig(data_parallel=0),
+            offsets=OffsetsConfig(policy="earliest", max_behind=None),
+            input_topic=f"{name}-in",
+            output_topic=f"{name}-out",
+            dead_letter_topic=f"{name}-dlq",
+            spout_parallelism=2,
+            inference_parallelism=mc["bolts"],
+            sink_parallelism=2,
+        )
+        for name, mc in MULTI_MODELS.items()
+    ]
+    return run_cfg, build_multi_model_topology(run_cfg, broker)
+
+
+def run_multi(args) -> None:
+    """Multi-model bench: both pipelines drain concurrently from one broker
+    through one TPU; reports combined images/sec/chip and the worse of the
+    two per-pipeline p50s."""
+    import jax
+
+    from storm_tpu.connectors import MemoryBroker
+    from storm_tpu.runtime.cluster import LocalCluster
+
+    n_dev = len(jax.devices())
+    log(f"devices: {jax.devices()}")
+    payloads = {
+        name: make_payloads(mc, instances_per_msg=args.instances_per_msg)
+        for name, mc in MULTI_MODELS.items()
+    }
+    cluster = LocalCluster()
+
+    # ---- throughput phase ----------------------------------------------------
+    broker = MemoryBroker(default_partitions=4)
+    run_cfg, topo = build_multi_topology(
+        broker, max(args.max_wait_ms, 100.0), args.transfer_dtype, args.max_batch)
+    t0 = time.time()
+    cluster.submit_topology("bench-multi", run_cfg, topo)
+    log(f"submitted + warmed up in {time.time() - t0:.1f}s")
+
+    per_topic = args.messages // 2
+    n_msgs = per_topic * 2
+    imgs_total = n_msgs * args.instances_per_msg
+    for i in range(per_topic):
+        for name in MULTI_MODELS:
+            broker.produce(f"{name}-in", payloads[name][i % len(payloads[name])])
+    t0 = time.perf_counter()
+    last = 0
+    while True:
+        done = sum(broker.topic_size(f"{n}-out") + broker.topic_size(f"{n}-dlq")
+                   for n in MULTI_MODELS)
+        if done >= n_msgs:
+            break
+        now = time.perf_counter()
+        if now - t0 > 600:
+            log(f"TIMEOUT with {done}/{n_msgs} delivered")
+            break
+        if done - last >= n_msgs // 8:
+            log(f"  {done}/{n_msgs} @ {done * args.instances_per_msg / (now - t0):.0f} img/s")
+            last = done
+        time.sleep(0.05)
+    elapsed = time.perf_counter() - t0
+    throughput = imgs_total / elapsed / n_dev
+    log(f"throughput: {imgs_total} imgs in {elapsed:.2f}s -> "
+        f"{throughput:.0f} img/s/chip ({n_dev} chip(s), 2 models co-resident)")
+    dead = sum(broker.topic_size(f"{n}-dlq") for n in MULTI_MODELS)
+    if dead:
+        log(f"WARNING: {dead} dead-lettered")
+    cluster.kill_topology("bench-multi", wait_secs=2)
+
+    # ---- latency phase -------------------------------------------------------
+    p50 = p99 = float("nan")
+    if not args.skip_latency:
+        broker2 = MemoryBroker(default_partitions=4)
+        run_cfg2, topo2 = build_multi_topology(broker2, args.max_wait_ms,
+                                               args.transfer_dtype, args.max_batch)
+        cluster.submit_topology("bench-multi-lat", run_cfg2, topo2)
+        rate = max(8.0, throughput * n_dev * 0.3)
+        interval = 1.0 / rate
+        log(f"latency phase: offered {rate:.0f} msg/s (interleaved) for "
+            f"{args.latency_seconds}s")
+        names = list(MULTI_MODELS)
+        sent = 0
+        t0 = time.perf_counter()
+        end = t0 + args.latency_seconds
+        nxt = t0
+        while time.perf_counter() < end:
+            now = time.perf_counter()
+            while nxt <= now:
+                name = names[sent % len(names)]
+                broker2.produce(f"{name}-in", payloads[name][sent % len(payloads[name])])
+                sent += 1
+                nxt += interval
+            time.sleep(min(0.002, max(0.0, nxt - time.perf_counter())))
+        while sum(broker2.topic_size(f"{n}-out") for n in names) < sent:
+            if time.perf_counter() - end > 60:
+                break
+            time.sleep(0.05)
+        snap = cluster.metrics("bench-multi-lat")
+        p50s, p99s = [], []
+        for name in names:
+            lat = snap[f"{name}-sink"]["e2e_latency_ms"]
+            if lat["p50"] is not None:
+                p50s.append(lat["p50"])
+                p99s.append(lat["p99"])
+                log(f"  {name}: p50={lat['p50']:.1f} p99={lat['p99']:.1f}")
+        if p50s:
+            p50, p99 = max(p50s), max(p99s)
+        cluster.kill_topology("bench-multi-lat", wait_secs=2)
+
+    cluster.shutdown()
+    result = {
+        "metric": "multi_mnist_cifar_images_per_sec_per_chip",
+        "value": round(throughput, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(throughput / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+        "p50_latency_ms": round(p50, 1) if p50 == p50 else None,
+        "p99_latency_ms": round(p99, 1) if p99 == p99 else None,
+        "chips": n_dev,
+        "config": "multi",
+    }
+    print(json.dumps(result))
 
 
 def build_topology(cfg, broker, batch_cfg, transfer_dtype=None):
@@ -102,6 +254,9 @@ def main() -> None:
                          "bytes than f32 over the link; lossy, opt-in)")
     ap.add_argument("--skip-latency", action="store_true")
     args = ap.parse_args()
+    if args.config == "multi":
+        run_multi(args)
+        return
     cfg = CONFIGS[args.config]
 
     import jax

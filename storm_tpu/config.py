@@ -151,6 +151,54 @@ class BrokerConfig:
     partitions: int = 4  # partitions for memory broker topics
 
 
+def _apply_section(target, values: dict) -> None:
+    """Apply a dict of key->value onto a config dataclass instance, coercing
+    lists to tuples where the field is a tuple and re-running validation."""
+    for k, v in values.items():
+        if not hasattr(target, k):
+            raise KeyError(f"unknown config key {k!r} for {type(target).__name__}")
+        cur = getattr(target, k)
+        if isinstance(cur, tuple) and isinstance(v, list):
+            v = tuple(v)
+        setattr(target, k, v)
+    if hasattr(target, "__post_init__"):
+        target.__post_init__()
+
+
+@dataclass
+class PipelineConfig:
+    """One model pipeline (spout -> inference -> sink) inside a multi-model
+    topology: several of these share one process and one TPU slice
+    (BASELINE.json config 5, "MNIST+CIFAR bolts sharing one v5e-8"). Params
+    for each model are co-resident in HBM; compiled executables are cached
+    per (model, bucket) by the engine layer."""
+
+    name: str = "pipeline"
+    model: ModelConfig = field(default_factory=ModelConfig)
+    batch: BatchConfig = field(default_factory=BatchConfig)
+    sharding: ShardingConfig = field(default_factory=ShardingConfig)
+    offsets: OffsetsConfig = field(default_factory=OffsetsConfig)
+    input_topic: str = "input"
+    output_topic: str = "output"
+    dead_letter_topic: str = "dead-letter"
+    spout_parallelism: int = 1
+    inference_parallelism: int = 1
+    sink_parallelism: int = 1
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PipelineConfig":
+        p = cls()
+        for k, v in d.items():
+            if not hasattr(p, k):
+                raise KeyError(f"unknown pipeline key {k!r}")
+            cur = getattr(p, k)
+            if dataclasses.is_dataclass(cur) and isinstance(v, dict):
+                _apply_section(cur, v)
+            else:
+                setattr(p, k, v)
+        return p
+
+
 @dataclass
 class Config:
     topology: TopologyConfig = field(default_factory=TopologyConfig)
@@ -160,6 +208,9 @@ class Config:
     offsets: OffsetsConfig = field(default_factory=OffsetsConfig)
     sink: SinkConfig = field(default_factory=SinkConfig)
     broker: BrokerConfig = field(default_factory=BrokerConfig)
+    # Multi-model topology: non-empty => ``run`` builds one spout->infer->sink
+    # chain per entry instead of the single-model DAG. TOML: [[pipelines]].
+    pipelines: list = field(default_factory=list)
 
     # ---- loading / overriding -------------------------------------------------
 
@@ -173,18 +224,18 @@ class Config:
         for section, values in d.items():
             if not hasattr(self, section):
                 raise KeyError(f"unknown config section {section!r}")
+            if section == "pipelines":
+                if not isinstance(values, list):
+                    raise TypeError("config section 'pipelines' must be a list of tables")
+                self.pipelines = [
+                    v if isinstance(v, PipelineConfig) else PipelineConfig.from_dict(v)
+                    for v in values
+                ]
+                continue
             sub = getattr(self, section)
             if not isinstance(values, dict):
                 raise TypeError(f"config section {section!r} must be a table/dict")
-            for k, v in values.items():
-                if not hasattr(sub, k):
-                    raise KeyError(f"unknown config key {section}.{k}")
-                cur = getattr(sub, k)
-                if isinstance(cur, tuple) and isinstance(v, list):
-                    v = tuple(v)
-                setattr(sub, k, v)
-            if hasattr(sub, "__post_init__"):
-                sub.__post_init__()
+            _apply_section(sub, values)
 
     @classmethod
     def load(cls, path: str | Path) -> "Config":

@@ -60,6 +60,47 @@ def build_standard_topology(cfg: Config, broker):
     return tb.build()
 
 
+def build_multi_model_topology(cfg: Config, broker):
+    """One spout -> inference -> sink chain per ``cfg.pipelines`` entry, all
+    inside a single topology sharing one process and one TPU slice
+    (BASELINE.json config 5). Each pipeline has its own model/batch/sharding
+    and topics; component ids are namespaced by pipeline name. Engines are
+    cached per model by :func:`storm_tpu.infer.engine.shared_engine`, so two
+    pipelines running the same model share params in HBM while different
+    models are co-resident."""
+    from storm_tpu.connectors import BrokerSink, BrokerSpout
+    from storm_tpu.infer import InferenceBolt
+    from storm_tpu.runtime import TopologyBuilder
+
+    if not cfg.pipelines:
+        raise ValueError("build_multi_model_topology needs cfg.pipelines")
+    tb = TopologyBuilder()
+    for p in cfg.pipelines:
+        spout_id = f"{p.name}-spout"
+        infer_id = f"{p.name}-inference"
+        tb.set_spout(
+            spout_id,
+            BrokerSpout(broker, p.input_topic, p.offsets),
+            parallelism=p.spout_parallelism,
+        )
+        tb.set_bolt(
+            infer_id,
+            InferenceBolt(p.model, p.batch, p.sharding),
+            parallelism=p.inference_parallelism,
+        ).shuffle_grouping(spout_id)
+        tb.set_bolt(
+            f"{p.name}-sink",
+            BrokerSink(broker, p.output_topic, cfg.sink),
+            parallelism=p.sink_parallelism,
+        ).shuffle_grouping(infer_id)
+        tb.set_bolt(
+            f"{p.name}-dlq",
+            BrokerSink(broker, p.dead_letter_topic, cfg.sink),
+            parallelism=1,
+        ).shuffle_grouping(infer_id, stream="dead_letter")
+    return tb.build()
+
+
 def _make_broker(cfg: Config):
     if cfg.broker.kind == "memory":
         from storm_tpu.connectors import MemoryBroker
@@ -84,11 +125,16 @@ async def _run_daemon(name: str, cfg: Config, duration: float) -> None:
     from storm_tpu.runtime.cluster import AsyncLocalCluster
 
     broker = _make_broker(cfg)
-    topo = build_standard_topology(cfg, broker)
+    if cfg.pipelines:
+        topo = build_multi_model_topology(cfg, broker)
+        desc = "+".join(p.model.name for p in cfg.pipelines)
+    else:
+        topo = build_standard_topology(cfg, broker)
+        desc = cfg.model.name
     cluster = AsyncLocalCluster()
     rt = await cluster.submit(name, cfg, topo)
     print(f"topology {name!r} running "
-          f"(model={cfg.model.name}, broker={cfg.broker.kind})", file=sys.stderr)
+          f"(model={desc}, broker={cfg.broker.kind})", file=sys.stderr)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -136,6 +182,13 @@ def main(argv=None) -> int:
         cfg = _load_config(args)
         cfg.broker.input_topic = args.input_topic
         cfg.broker.output_topic = args.output_topic
+        if cfg.pipelines:
+            print(
+                "note: multi-model config — per-pipeline topics are used; the "
+                f"positional topics {args.input_topic!r}/{args.output_topic!r} "
+                "are ignored",
+                file=sys.stderr,
+            )
         asyncio.run(_run_daemon(args.name, cfg, args.duration))
         return 0
 

@@ -1,0 +1,138 @@
+"""Multi-model topology: two model pipelines (MNIST LeNet-5 + CIFAR-10
+ResNet-20) sharing one process / one device slice — BASELINE.json config 5.
+
+The reference can only run one model per topology (the model ships inside
+the application jar, InferenceBolt.java:49-57); here several pipelines with
+different models, shapes, and batch policies coexist in one topology, with
+per-model engines co-resident and cached (storm_tpu/infer/engine.py
+shared_engine)."""
+
+import asyncio
+import json
+
+import numpy as np
+
+from storm_tpu.api.schema import decode_predictions
+from storm_tpu.config import (
+    BatchConfig,
+    Config,
+    ModelConfig,
+    OffsetsConfig,
+    PipelineConfig,
+    ShardingConfig,
+)
+from storm_tpu.connectors import MemoryBroker
+from storm_tpu.main import build_multi_model_topology
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+
+def _payload(shape, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(1, *shape).astype(np.float32)
+    return json.dumps({"instances": x.tolist()})
+
+
+def _pipelines():
+    earliest = lambda: OffsetsConfig(policy="earliest", max_behind=None)
+    mnist = PipelineConfig(
+        name="mnist",
+        model=ModelConfig(name="lenet5", dtype="float32", input_shape=(28, 28, 1)),
+        batch=BatchConfig(max_batch=8, max_wait_ms=10, buckets=(8,)),
+        sharding=ShardingConfig(data_parallel=0),
+        offsets=earliest(),
+        input_topic="mnist-in",
+        output_topic="mnist-out",
+        dead_letter_topic="mnist-dlq",
+        inference_parallelism=2,
+    )
+    cifar = PipelineConfig(
+        name="cifar",
+        model=ModelConfig(
+            name="resnet20", dtype="float32", input_shape=(32, 32, 3), num_classes=10
+        ),
+        batch=BatchConfig(max_batch=4, max_wait_ms=10, buckets=(4,)),
+        sharding=ShardingConfig(data_parallel=0),
+        offsets=earliest(),
+        input_topic="cifar-in",
+        output_topic="cifar-out",
+        dead_letter_topic="cifar-dlq",
+    )
+    return [mnist, cifar]
+
+
+async def _run_multi(n_per_model=6):
+    broker = MemoryBroker(default_partitions=2)
+    cfg = Config()
+    cfg.pipelines = _pipelines()
+
+    topo = build_multi_model_topology(cfg, broker)
+    cluster = AsyncLocalCluster()
+    rt = await cluster.submit("multi", cfg, topo)
+
+    for i in range(n_per_model):
+        broker.produce("mnist-in", _payload((28, 28, 1), seed=i))
+        broker.produce("cifar-in", _payload((32, 32, 3), seed=100 + i))
+
+    deadline = asyncio.get_event_loop().time() + 90
+    while asyncio.get_event_loop().time() < deadline:
+        if (
+            broker.topic_size("mnist-out") >= n_per_model
+            and broker.topic_size("cifar-out") >= n_per_model
+        ):
+            break
+        await asyncio.sleep(0.05)
+    await rt.drain(timeout_s=30)
+    snap = rt.metrics.snapshot()
+    out = {
+        "mnist": broker.drain_topic("mnist-out"),
+        "cifar": broker.drain_topic("cifar-out"),
+        "dlq": broker.drain_topic("mnist-dlq") + broker.drain_topic("cifar-dlq"),
+    }
+    await cluster.shutdown()
+    return out, snap
+
+
+def test_multimodel_config_roundtrip():
+    cfg = Config.from_dict(
+        {
+            "pipelines": [
+                {
+                    "name": "mnist",
+                    "model": {"name": "lenet5", "input_shape": [28, 28, 1]},
+                    "input_topic": "a",
+                    "output_topic": "b",
+                },
+                {
+                    "name": "cifar",
+                    "model": {"name": "resnet20", "input_shape": [32, 32, 3]},
+                    "batch": {"max_batch": 16, "buckets": [16]},
+                },
+            ]
+        }
+    )
+    assert len(cfg.pipelines) == 2
+    assert cfg.pipelines[0].model.name == "lenet5"
+    assert cfg.pipelines[0].model.input_shape == (28, 28, 1)
+    assert cfg.pipelines[1].batch.max_batch == 16
+
+
+def test_multimodel_topology_shapes():
+    cfg = Config()
+    cfg.pipelines = _pipelines()
+    topo = build_multi_model_topology(cfg, MemoryBroker())
+    ids = set(topo.specs)
+    assert {"mnist-spout", "mnist-inference", "mnist-sink", "mnist-dlq"} <= ids
+    assert {"cifar-spout", "cifar-inference", "cifar-sink", "cifar-dlq"} <= ids
+
+
+def test_multimodel_end_to_end(run):
+    out, snap = run(_run_multi(n_per_model=6), timeout=180)
+    assert len(out["dlq"]) == 0
+    assert len(out["mnist"]) == 6
+    assert len(out["cifar"]) == 6
+    for r in out["mnist"] + out["cifar"]:
+        preds = decode_predictions(r.value)
+        assert preds.data.shape == (1, 10)
+        np.testing.assert_allclose(preds.data.sum(), 1.0, atol=1e-4)
+    assert snap["mnist-inference"]["instances_inferred"] == 6
+    assert snap["cifar-inference"]["instances_inferred"] == 6
