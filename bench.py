@@ -115,28 +115,16 @@ def run_multi(args) -> None:
 
     per_topic = args.messages // 2
     n_msgs = per_topic * 2
-    imgs_total = n_msgs * args.instances_per_msg
     for i in range(per_topic):
         for name in MULTI_MODELS:
             broker.produce(f"{name}-in", payloads[name][i % len(payloads[name])])
-    t0 = time.perf_counter()
-    last = 0
-    while True:
-        done = sum(broker.topic_size(f"{n}-out") + broker.topic_size(f"{n}-dlq")
-                   for n in MULTI_MODELS)
-        if done >= n_msgs:
-            break
-        now = time.perf_counter()
-        if now - t0 > 600:
-            log(f"TIMEOUT with {done}/{n_msgs} delivered")
-            break
-        if done - last >= n_msgs // 8:
-            log(f"  {done}/{n_msgs} @ {done * args.instances_per_msg / (now - t0):.0f} img/s")
-            last = done
-        time.sleep(0.05)
-    elapsed = time.perf_counter() - t0
-    throughput = imgs_total / elapsed / n_dev
-    log(f"throughput: {imgs_total} imgs in {elapsed:.2f}s -> "
+    delivered, elapsed = drain_loop(
+        lambda: sum(broker.topic_size(f"{n}-out") + broker.topic_size(f"{n}-dlq")
+                    for n in MULTI_MODELS),
+        n_msgs, args.instances_per_msg)
+    imgs_done = delivered * args.instances_per_msg
+    throughput = imgs_done / elapsed / n_dev
+    log(f"throughput: {imgs_done} imgs in {elapsed:.2f}s -> "
         f"{throughput:.0f} img/s/chip ({n_dev} chip(s), 2 models co-resident)")
     dead = sum(broker.topic_size(f"{n}-dlq") for n in MULTI_MODELS)
     if dead:
@@ -151,26 +139,17 @@ def run_multi(args) -> None:
                                                args.transfer_dtype, args.max_batch)
         cluster.submit_topology("bench-multi-lat", run_cfg2, topo2)
         rate = max(8.0, throughput * n_dev * 0.3)
-        interval = 1.0 / rate
         log(f"latency phase: offered {rate:.0f} msg/s (interleaved) for "
             f"{args.latency_seconds}s")
         names = list(MULTI_MODELS)
-        sent = 0
-        t0 = time.perf_counter()
-        end = t0 + args.latency_seconds
-        nxt = t0
-        while time.perf_counter() < end:
-            now = time.perf_counter()
-            while nxt <= now:
-                name = names[sent % len(names)]
-                broker2.produce(f"{name}-in", payloads[name][sent % len(payloads[name])])
-                sent += 1
-                nxt += interval
-            time.sleep(min(0.002, max(0.0, nxt - time.perf_counter())))
-        while sum(broker2.topic_size(f"{n}-out") for n in names) < sent:
-            if time.perf_counter() - end > 60:
-                break
-            time.sleep(0.05)
+
+        def produce_nth(i):
+            name = names[i % len(names)]
+            broker2.produce(f"{name}-in", payloads[name][i % len(payloads[name])])
+
+        sent = offer_load(produce_nth, rate, args.latency_seconds)
+        await_outputs(
+            lambda: sum(broker2.topic_size(f"{n}-out") for n in names), sent)
         snap = cluster.metrics("bench-multi-lat")
         p50s, p99s = [], []
         for name in names:
@@ -240,6 +219,51 @@ def make_payloads(cfg, n_distinct=64, instances_per_msg=1):
     ]
 
 
+def drain_loop(done_fn, n_msgs, instances_per_msg, timeout_s=600.0):
+    """Wait until ``done_fn()`` reaches n_msgs (or timeout). Returns
+    (delivered, elapsed_s) — throughput must be computed from *delivered*,
+    not offered, so a timeout never inflates the metric."""
+    t0 = time.perf_counter()
+    last = 0
+    while True:
+        done = done_fn()
+        if done >= n_msgs:
+            break
+        now = time.perf_counter()
+        if now - t0 > timeout_s:
+            log(f"TIMEOUT with {done}/{n_msgs} delivered")
+            break
+        if done - last >= max(1, n_msgs // 8):
+            log(f"  {done}/{n_msgs} @ {done * instances_per_msg / (now - t0):.0f} img/s")
+            last = done
+        time.sleep(0.05)
+    return done_fn(), time.perf_counter() - t0
+
+
+def offer_load(produce_nth, rate, seconds):
+    """Paced open-loop producer: call ``produce_nth(i)`` at ``rate``/s for
+    ``seconds``. Returns the number of messages offered."""
+    interval = 1.0 / rate
+    sent = 0
+    t0 = time.perf_counter()
+    end = t0 + seconds
+    nxt = t0
+    while time.perf_counter() < end:
+        now = time.perf_counter()
+        while nxt <= now:
+            produce_nth(sent)
+            sent += 1
+            nxt += interval
+        time.sleep(min(0.002, max(0.0, nxt - time.perf_counter())))
+    return sent
+
+
+def await_outputs(size_fn, sent, grace_s=60.0):
+    end = time.perf_counter() + grace_s
+    while size_fn() < sent and time.perf_counter() < end:
+        time.sleep(0.05)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="resnet20", choices=sorted(CONFIGS))
@@ -283,26 +307,14 @@ def main() -> None:
     log(f"submitted + warmed up in {time.time() - t0:.1f}s")
 
     n_msgs = args.messages
-    imgs_total = n_msgs * args.instances_per_msg
     for i in range(n_msgs):
         broker.produce("input", payloads[i % len(payloads)])
-    t0 = time.perf_counter()
-    last = 0
-    while True:
-        done = broker.topic_size("output") + broker.topic_size("dead-letter")
-        if done >= n_msgs:
-            break
-        now = time.perf_counter()
-        if now - t0 > 600:
-            log(f"TIMEOUT with {done}/{n_msgs} delivered")
-            break
-        if done - last >= n_msgs // 8:
-            log(f"  {done}/{n_msgs} @ {done * args.instances_per_msg / (now - t0):.0f} img/s")
-            last = done
-        time.sleep(0.05)
-    elapsed = time.perf_counter() - t0
-    throughput = imgs_total / elapsed / n_dev
-    log(f"throughput: {imgs_total} imgs in {elapsed:.2f}s -> "
+    delivered, elapsed = drain_loop(
+        lambda: broker.topic_size("output") + broker.topic_size("dead-letter"),
+        n_msgs, args.instances_per_msg)
+    imgs_done = delivered * args.instances_per_msg
+    throughput = imgs_done / elapsed / n_dev
+    log(f"throughput: {imgs_done} imgs in {elapsed:.2f}s -> "
         f"{throughput:.0f} img/s/chip ({n_dev} chip(s))")
     dead = broker.topic_size("dead-letter")
     if dead:
@@ -331,23 +343,11 @@ def main() -> None:
         # deadline (small batches), so its capacity is below the
         # throughput-phase number.
         rate = max(8.0, throughput * n_dev * 0.3)
-        interval = 1.0 / rate
         log(f"latency phase: offered {rate:.0f} msg/s for {args.latency_seconds}s")
-        sent = 0
-        t0 = time.perf_counter()
-        end = t0 + args.latency_seconds
-        nxt = t0
-        while time.perf_counter() < end:
-            now = time.perf_counter()
-            while nxt <= now:
-                broker2.produce("input", payloads[sent % len(payloads)])
-                sent += 1
-                nxt += interval
-            time.sleep(min(0.002, max(0.0, nxt - time.perf_counter())))
-        while broker2.topic_size("output") < sent:
-            if time.perf_counter() - end > 60:
-                break
-            time.sleep(0.05)
+        sent = offer_load(
+            lambda i: broker2.produce("input", payloads[i % len(payloads)]),
+            rate, args.latency_seconds)
+        await_outputs(lambda: broker2.topic_size("output"), sent)
         snap = cluster.metrics("bench-latency")
         lat = snap["kafka-bolt"]["e2e_latency_ms"]
         p50 = lat["p50"] if lat["p50"] is not None else float("nan")

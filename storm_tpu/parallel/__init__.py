@@ -1,3 +1,12 @@
+"""Parallelism strategies over the TPU mesh (SURVEY.md §2.4 checklist):
+dp/tp (mesh, sharding, train), pp (pipeline), sp (ring_attention, sequence),
+ep (moe).
+
+Submodules that pull heavier deps (optax for training, the model registry)
+are imported lazily so inference-only paths (`storm_tpu.infer`,
+`storm_tpu.main serve`) never pay for them at import time.
+"""
+
 from storm_tpu.parallel.mesh import make_mesh, default_mesh
 from storm_tpu.parallel.sharding import (
     batch_sharding,
@@ -6,6 +15,28 @@ from storm_tpu.parallel.sharding import (
     shard_params_tp,
 )
 
+_LAZY = {
+    "ring_attention": ("storm_tpu.parallel.ring_attention", "ring_attention"),
+    "pipeline_apply": ("storm_tpu.parallel.pipeline", "pipeline_apply"),
+    "init_pp_training": ("storm_tpu.parallel.pipeline", "init_pp_training"),
+    "moe_init": ("storm_tpu.parallel.moe", "moe_init"),
+    "moe_layer": ("storm_tpu.parallel.moe", "moe_layer"),
+    "moe_block": ("storm_tpu.parallel.moe", "moe_block"),
+    "shard_moe_params": ("storm_tpu.parallel.moe", "shard_moe_params"),
+    "seq_parallel_block": ("storm_tpu.parallel.sequence", "seq_parallel_block"),
+    "seq_parallel_encoder": ("storm_tpu.parallel.sequence", "seq_parallel_encoder"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "make_mesh",
     "default_mesh",
@@ -13,4 +44,5 @@ __all__ = [
     "replicated",
     "shard_batch",
     "shard_params_tp",
+    *_LAZY,
 ]

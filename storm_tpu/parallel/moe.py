@@ -1,0 +1,144 @@
+"""Expert parallelism: a mixture-of-experts MLP with experts sharded over a
+mesh axis (GShard-style dispatch).
+
+Absent from the reference (SURVEY.md §2.4 EP row: "no MoE anywhere"); built
+here the declarative TPU way rather than with hand-written all-to-alls:
+
+- expert weights are stacked on a leading E axis and sharded over the
+  ``expert`` mesh axis (each device holds E / n_expert_shards experts);
+- tokens pick a top-1 expert via a learned gate; a capacity-bounded one-hot
+  dispatch tensor turns routing into three einsums (dispatch, expert MLP,
+  combine) — all MXU work, no gather/scatter;
+- with tokens sharded over ``data`` and experts over ``expert``, XLA/GSPMD
+  lowers the dispatch/combine einsums into the all-to-all pattern on ICI;
+  user code contains zero explicit collectives (SURVEY.md §2.5).
+
+Capacity semantics: each expert processes at most
+``ceil(tokens / E * capacity_factor)``; overflow tokens are dropped (their
+output is 0 through the residual connection) — standard GShard/Switch
+behavior, deterministic and shape-static for XLA.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def moe_init(
+    rng, dim: int, mlp_dim: int, n_experts: int, dtype=jnp.float32
+) -> dict:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale_in = 1.0 / math.sqrt(dim)
+    scale_out = 1.0 / math.sqrt(mlp_dim)
+    return {
+        "gate": (jax.random.normal(k1, (dim, n_experts), dtype) * scale_in),
+        "w_in": (jax.random.normal(k2, (n_experts, dim, mlp_dim), dtype) * scale_in),
+        "b_in": jnp.zeros((n_experts, mlp_dim), dtype),
+        "w_out": (jax.random.normal(k3, (n_experts, mlp_dim, dim), dtype) * scale_out),
+        "b_out": jnp.zeros((n_experts, dim), dtype),
+    }
+
+
+def moe_param_specs(expert_axis: str = "expert") -> dict:
+    """PartitionSpecs matching :func:`moe_init`: experts sharded on their
+    leading axis, gate replicated."""
+    return {
+        "gate": P(),
+        "w_in": P(expert_axis),
+        "b_in": P(expert_axis),
+        "w_out": P(expert_axis),
+        "b_out": P(expert_axis),
+    }
+
+
+def shard_moe_params(mesh: Mesh, params: dict, expert_axis: str = "expert") -> dict:
+    specs = moe_param_specs(expert_axis)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k])) for k, v in params.items()
+    }
+
+
+def moe_layer(
+    p: dict,
+    x: jnp.ndarray,
+    capacity_factor: float = 1.25,
+    aux_loss_weight: float = 1e-2,
+):
+    """Top-1 MoE MLP over tokens.
+
+    ``x``: (..., dim) — leading dims are flattened into a token axis.
+    Returns ``(y, aux_loss)``: y has x's shape (overflowed tokens yield 0);
+    ``aux_loss`` is the Switch-Transformer load-balancing loss (mean over
+    experts of fraction-of-tokens x mean-gate-prob, scaled by E), already
+    multiplied by ``aux_loss_weight``.
+    """
+    orig_shape = x.shape
+    dim = orig_shape[-1]
+    tokens = x.reshape(-1, dim)
+    n = tokens.shape[0]
+    e = p["w_in"].shape[0]
+    cap = max(1, math.ceil(n / e * capacity_factor))
+
+    logits = (tokens @ p["gate"].astype(tokens.dtype)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # (N, E)
+    expert = jnp.argmax(probs, axis=-1)  # (N,)
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (N, E)
+
+    # Position of each token within its chosen expert's queue; >= cap drops.
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (N, E)
+    keep = onehot * (pos < cap)  # (N, E)
+    pos_cap = jax.nn.one_hot(jnp.sum(pos, axis=-1).astype(jnp.int32), cap,
+                             dtype=jnp.float32)  # (N, C)
+    dispatch = jnp.einsum("ne,nc->nec", keep, pos_cap)  # (N, E, C)
+    gate_val = jnp.sum(probs * keep, axis=-1)  # (N,)
+    combine = dispatch * gate_val[:, None, None]  # (N, E, C)
+
+    xt = tokens.astype(jnp.float32)
+    xe = jnp.einsum("nec,nd->ecd", dispatch, xt).astype(tokens.dtype)  # (E, C, d)
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edh->ech", xe, p["w_in"].astype(xe.dtype))
+        + p["b_in"].astype(xe.dtype)[:, None, :]
+    )
+    ye = (
+        jnp.einsum("ech,ehd->ecd", h, p["w_out"].astype(h.dtype))
+        + p["b_out"].astype(h.dtype)[:, None, :]
+    )  # (E, C, d)
+    y = jnp.einsum("nec,ecd->nd", combine, ye.astype(jnp.float32))
+
+    # Switch load-balancing loss: encourages uniform routing.
+    frac_tokens = jnp.mean(onehot, axis=0)  # (E,)
+    mean_prob = jnp.mean(probs, axis=0)  # (E,)
+    aux = aux_loss_weight * e * jnp.sum(frac_tokens * mean_prob)
+
+    return y.astype(x.dtype).reshape(orig_shape), aux
+
+
+def moe_block_init(rng, dim: int, mlp_dim: int, num_heads: int, n_experts: int):
+    """A transformer block whose MLP is an MoE: ln1/attn/ln2 as in the ViT
+    block, MoE replacing the dense MLP."""
+    from storm_tpu.ops import layers as L
+    from storm_tpu.ops.attention import mha_init
+
+    k1, k2 = jax.random.split(rng)
+    return {
+        "ln1": L.layernorm_init(dim),
+        "attn": mha_init(k1, dim, num_heads),
+        "ln2": L.layernorm_init(dim),
+        "moe": moe_init(k2, dim, mlp_dim, n_experts),
+    }
+
+
+def moe_block(p: dict, x: jnp.ndarray, num_heads: int,
+              capacity_factor: float = 1.25):
+    """(B, S, D) -> ((B, S, D), aux_loss)."""
+    from storm_tpu.ops import layers as L
+    from storm_tpu.ops.attention import multi_head_attention
+
+    x = x + multi_head_attention(p["attn"], L.layernorm(p["ln1"], x), num_heads)
+    h, aux = moe_layer(p["moe"], L.layernorm(p["ln2"], x),
+                       capacity_factor=capacity_factor)
+    return x + h, aux

@@ -1,0 +1,216 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+The reference's only "pipeline" is the spout -> infer -> sink operator DAG
+across processes (MainTopology.java:61-63, SURVEY.md §2.4 PP row); the model
+itself is never split. This module adds intra-model pipeline parallelism the
+TPU way, for models that outgrow one chip:
+
+- transformer blocks are grouped into ``n_stages`` stages; per-stage params
+  are stacked on a leading axis and sharded over the ``stage`` mesh axis,
+  so each device (column of devices) holds only its stage's weights;
+- inside ``shard_map``, a ``lax.scan`` runs the classic pipeline schedule:
+  at step t, stage s computes microbatch (t - s) and hands its activation to
+  stage s+1 with ``lax.ppermute`` — a single-hop ICI neighbor transfer that
+  XLA overlaps with the next microbatch's compute;
+- the schedule runs ``n_micro + n_stages - 1`` steps (the n_stages - 1 extra
+  are the fill/drain bubbles); the last stage collects outputs;
+- everything is built from ``scan``/``ppermute``/``psum``, so ``jax.grad``
+  flows through the whole pipeline — the backward pass is the mirrored
+  pipeline schedule, derived by AD instead of hand-written.
+
+Composes with data parallelism: on a ``(data, stage)`` mesh the microbatch
+batch dim is sharded over ``data`` while activations hop over ``stage``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from storm_tpu.models.registry import ModelDef
+
+
+def stack_stages(per_stage: list) -> Any:
+    """Stack a list of identical pytrees (one per stage) along a new leading
+    axis — the axis that is sharded over the ``stage`` mesh axis."""
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *per_stage)
+
+
+def split_blocks(blocks: list, n_stages: int) -> Any:
+    """Group a model's block list into stage-stacked params with leaves of
+    shape (n_stages, blocks_per_stage, ...)."""
+    if len(blocks) % n_stages:
+        raise ValueError(f"{len(blocks)} blocks not divisible into {n_stages} stages")
+    bps = len(blocks) // n_stages
+    stages = [
+        stack_stages(blocks[s * bps : (s + 1) * bps]) for s in range(n_stages)
+    ]
+    return stack_stages(stages)
+
+
+def pipeline_apply(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x_micro: jnp.ndarray,
+    stage_axis: str = "stage",
+    data_axis: Optional[str] = "data",
+) -> jnp.ndarray:
+    """Run ``x_micro`` (n_micro, mb, ...) through the staged pipeline.
+
+    ``stage_params`` leaves have leading axis n_stages (sharded over
+    ``stage_axis``); ``stage_fn(local_params, act) -> act`` must preserve the
+    activation shape (true of transformer blocks). Batch dim (axis 1) is
+    sharded over ``data_axis`` when that axis is in the mesh. Returns the
+    pipeline output in microbatch layout, same shape as ``x_micro``.
+    """
+    n_stages = mesh.shape[stage_axis]
+    n_micro = x_micro.shape[0]
+    if n_micro < n_stages:
+        raise ValueError(
+            f"n_micro={n_micro} < n_stages={n_stages}: bubbles would dominate"
+        )
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    param_specs = jax.tree.map(lambda _: P(stage_axis), stage_params)
+    dspec = data_axis if (data_axis and data_axis in mesh.shape) else None
+    x_spec = P(None, dspec)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+    )
+    def run(stacked_local, xm):
+        # Each device sees a leading stage axis of size 1 — drop it.
+        local = jax.tree.map(lambda l: l[0], stacked_local)
+        idx = lax.axis_index(stage_axis)
+        # pcast: the zero init is device-invariant over the stage axis, but
+        # the scan carry becomes stage-varying after one hop — align VMAs.
+        recv0 = lax.pcast(jnp.zeros_like(xm[0]), (stage_axis,), to="varying")
+        outs0 = lax.pcast(jnp.zeros_like(xm), (stage_axis,), to="varying")
+
+        def step(carry, t):
+            recv, outs = carry
+            # Stage 0 feeds fresh microbatches during the fill window; other
+            # stages (and the drain window) consume the ppermute'd activation.
+            inp = jnp.where(
+                idx == 0, xm[jnp.clip(t, 0, n_micro - 1)], recv
+            )
+            out = stage_fn(local, inp)
+            mb = t - (n_stages - 1)
+            collected = lax.dynamic_update_index_in_dim(
+                outs, out, jnp.clip(mb, 0, n_micro - 1), 0
+            )
+            outs = jnp.where((idx == n_stages - 1) & (mb >= 0), collected, outs)
+            recv = lax.ppermute(out, stage_axis, perm)
+            return (recv, outs), None
+
+        (_, outs), _ = lax.scan(
+            step, (recv0, outs0), jnp.arange(n_micro + n_stages - 1)
+        )
+        # Outputs live on the last stage; psum broadcasts them so the result
+        # is replicated over the stage axis (zeros elsewhere contribute 0).
+        outs = lax.psum(
+            jnp.where(idx == n_stages - 1, outs, jnp.zeros_like(outs)), stage_axis
+        )
+        return outs
+
+    return run(stage_params, x_micro)
+
+
+# ---- pipelined ViT training ---------------------------------------------------
+
+
+def init_pp_training(
+    model: ModelDef,
+    mesh: Mesh,
+    n_micro: int = 4,
+    num_heads: Optional[int] = None,
+    seed: int = 0,
+    learning_rate: float = 1e-3,
+    stage_axis: str = "stage",
+    data_axis: Optional[str] = "data",
+):
+    """Pipeline-parallel training for the ViT family (homogeneous block
+    list): blocks stage-sharded over ``stage_axis``, embeddings/head
+    replicated, batch over ``data_axis``. Returns
+    ``(train_step, params, opt_state)`` where ``params = (rest, stages)``.
+
+    The reference has no training at all (frozen .pb, InferenceBolt.java:57);
+    this is the from-scratch construction of the one parallelism family the
+    reference's operator DAG gestures at (SURVEY.md §2.4 PP row).
+    """
+    from storm_tpu.models.vit import _block as vit_block
+
+    n_stages = mesh.shape[stage_axis]
+    params, _ = model.init(jax.random.PRNGKey(seed))
+    if "blocks" not in params:
+        raise ValueError(f"model {model.name!r} has no block list to pipeline")
+    heads = num_heads or getattr(model, "num_heads", None)
+    if heads is None:
+        # Infer: q kernel is (dim, dim); ViT-tiny/B use dim // 64 heads.
+        dim = params["blocks"][0]["attn"]["q"]["w"].shape[0]
+        heads = max(1, dim // 64)
+
+    stages = split_blocks(params["blocks"], n_stages)
+    rest = {k: v for k, v in params.items() if k != "blocks"}
+
+    stages = jax.device_put(
+        stages, NamedSharding(mesh, P(stage_axis))
+    )
+    rest = jax.device_put(rest, NamedSharding(mesh, P()))
+    opt = optax.adamw(learning_rate)
+    opt_state = jax.jit(opt.init)((rest, stages))
+
+    def stage_fn(local_blocks, act):
+        # local_blocks leaves: (blocks_per_stage, ...); scan over the blocks.
+        def body(h, pb):
+            return vit_block(pb, h, heads), None
+
+        out, _ = lax.scan(body, act, local_blocks)
+        return out
+
+    def forward(rest, stages, x):
+        b = x.shape[0]
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+        from storm_tpu.ops import layers as L
+
+        patch = rest["embed"]["w"].shape[0]
+        dim = rest["embed"]["w"].shape[-1]
+        tok = L.conv2d(rest["embed"], x, stride=patch, padding="VALID")
+        tok = tok.reshape(b, -1, dim)
+        cls = jnp.broadcast_to(rest["cls"].astype(tok.dtype), (b, 1, dim))
+        tok = jnp.concatenate([cls, tok], axis=1) + rest["pos"].astype(tok.dtype)
+
+        s, d = tok.shape[1], tok.shape[2]
+        micro = tok.reshape(n_micro, b // n_micro, s, d)
+        out = pipeline_apply(
+            mesh, stage_fn, stages, micro, stage_axis=stage_axis, data_axis=data_axis
+        )
+        tok = out.reshape(b, s, d)
+        tok = L.layernorm(rest["ln"], tok)
+        return L.dense(rest["head"], tok[:, 0])
+
+    def loss_fn(ps, x, y):
+        rest, stages = ps
+        logits = forward(rest, stages, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), y
+        ).mean()
+
+    @jax.jit
+    def train_step(ps, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(ps, x, y)
+        updates, opt_state = opt.update(grads, opt_state, ps)
+        ps = optax.apply_updates(ps, updates)
+        return ps, opt_state, loss
+
+    return train_step, (rest, stages), opt_state

@@ -86,16 +86,18 @@ def ring_attention(
     def inner(ql, kl, vl):
         acc, m, l = _partial_attention(ql, kl, vl, scale)
 
-        def body(_, carry):
+        def body(carry, _):
             k_cur, v_cur, m, l, acc = carry
             # Rotate KV shards one hop around the ring (ICI neighbors).
             k_nxt = lax.ppermute(k_cur, seq_axis, perm)
             v_nxt = lax.ppermute(v_cur, seq_axis, perm)
             acc_j, m_j, l_j = _partial_attention(ql, k_nxt, v_nxt, scale)
             m, l, acc = _merge(m, l, acc, m_j, l_j, acc_j)
-            return k_nxt, v_nxt, m, l, acc
+            return (k_nxt, v_nxt, m, l, acc), None
 
-        _, _, m, l, acc = lax.fori_loop(0, n - 1, body, (kl, vl, m, l, acc))
+        # scan (static trip count), not fori_loop: reverse-mode AD must flow
+        # through the ring for sequence-parallel training.
+        (_, _, m, l, acc), _ = lax.scan(body, (kl, vl, m, l, acc), None, length=n - 1)
         return (acc / l[..., None]).astype(ql.dtype)
 
     return inner(q, k, v)

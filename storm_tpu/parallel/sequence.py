@@ -1,0 +1,95 @@
+"""Sequence/context parallelism: transformer layers over a sequence axis
+sharded across the mesh.
+
+The reference has no sequence axis at all (fixed 4-D image tensors,
+InstObj.java:8, SURVEY.md §5.7). For long-context models served by this
+framework the sequence dim can exceed one chip's HBM; this module runs
+encoder blocks with the S axis sharded over a mesh axis:
+
+- LayerNorm, QKV/output projections, and the MLP are elementwise or
+  per-token matmuls — they run locally on each device's sequence shard with
+  zero communication;
+- the only cross-token op is attention, which runs as
+  :func:`storm_tpu.parallel.ring_attention.ring_attention` — KV shards
+  rotate around the ICI ring while each device keeps its query shard;
+- so one block = local matmuls + one ring pass; no all-gather of the
+  sequence ever materializes the full (S, D) activation on any chip.
+
+Everything is differentiable (the ring uses ``lax.scan``), so the same
+construction serves long-context training (the ``sp`` axis of
+``dryrun_multichip``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from storm_tpu.parallel.ring_attention import ring_attention
+
+
+def seq_sharding(mesh: Mesh, seq_axis: str = "seq") -> NamedSharding:
+    """(B, S, D) activations with S sharded."""
+    return NamedSharding(mesh, P(None, seq_axis, None))
+
+
+def seq_parallel_mha(
+    p: dict,
+    x: jnp.ndarray,
+    num_heads: int,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+) -> jnp.ndarray:
+    """Multi-head self-attention over (B, S, D) with S sharded over
+    ``seq_axis``. Projections are local; mixing runs on the ring."""
+    from storm_tpu.ops.layers import dense
+
+    b, s, c = x.shape
+    d = c // num_heads
+
+    def split(y):
+        return y.reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
+
+    q = split(dense(p["q"], x))
+    k = split(dense(p["k"], x))
+    v = split(dense(p["v"], x))
+    out = ring_attention(q, k, v, mesh, seq_axis=seq_axis)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, c)
+    return dense(p["o"], out)
+
+
+def seq_parallel_block(
+    p: dict,
+    x: jnp.ndarray,
+    num_heads: int,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+) -> jnp.ndarray:
+    """Pre-LN encoder block (same params as the ViT block,
+    models/vit.py:_block_init) with sequence-parallel attention."""
+    from storm_tpu.ops import layers as L
+
+    x = x + seq_parallel_mha(
+        p["attn"], L.layernorm(p["ln1"], x), num_heads, mesh, seq_axis
+    )
+    h = L.gelu(L.dense(p["mlp_in"], L.layernorm(p["ln2"], x)))
+    return x + L.dense(p["mlp_out"], h)
+
+
+def seq_parallel_encoder(
+    blocks: list,
+    x: jnp.ndarray,
+    num_heads: int,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+) -> jnp.ndarray:
+    """Apply a stack of blocks with the sequence axis sharded throughout.
+    ``x`` is placed with :func:`seq_sharding` so every local op stays on the
+    shard and only the attention rings communicate."""
+    x = jax.device_put(x, seq_sharding(mesh, seq_axis)) if not isinstance(
+        x, jax.core.Tracer
+    ) else x
+    for p in blocks:
+        x = seq_parallel_block(p, x, num_heads, mesh, seq_axis)
+    return x
