@@ -173,6 +173,9 @@ def main(argv=None) -> int:
     servep.add_argument("--set", action="append", default=[])
     servep.add_argument("--model", default=None, help="model registry name")
     servep.add_argument("--port", type=int, default=50051)
+    servep.add_argument("--cross-batch-ms", type=float, default=0.0,
+                        help="coalesce concurrent Predict RPCs into one "
+                             "device dispatch within this window (0 = off)")
 
     sub.add_parser("info", help="print devices and registered models")
 
@@ -198,7 +201,8 @@ def main(argv=None) -> int:
             cfg.model.name = args.model
         from storm_tpu.serve import InferenceWorker
 
-        worker = InferenceWorker(cfg.model, cfg.sharding, cfg.batch, port=args.port)
+        worker = InferenceWorker(cfg.model, cfg.sharding, cfg.batch, port=args.port,
+                                 cross_batch_ms=args.cross_batch_ms)
         worker.start()
         print(f"serving {cfg.model.name} on port {worker.port}", file=sys.stderr)
         try:

@@ -1,0 +1,88 @@
+"""Cross-caller micro-batching for the gRPC worker.
+
+The topology path batches inside one InferenceBolt; the serving path gets
+its batching here instead: concurrent Predict RPCs from *different* callers
+(e.g. several JVM Storm executors dispatching to one co-located TPU worker,
+the north-star deployment) are coalesced into one device dispatch.
+
+Leader-based window: the first request to arrive in an empty window becomes
+the leader, sleeps ``window_ms`` while followers queue up, then runs ONE
+``engine.predict`` over the concatenated batch and distributes the row
+slices back. Followers block on an event. While the leader is on-device, the
+next arrival starts a new window — windows pipeline behind the device queue.
+
+This is the server-side analogue of the reference's missing batching
+(one ``session.run`` per tuple, InferenceBolt.java:80-86, SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+class _Req:
+    __slots__ = ("x", "event", "out", "err")
+
+    def __init__(self, x: np.ndarray) -> None:
+        self.x = x
+        self.event = threading.Event()
+        self.out: Optional[np.ndarray] = None
+        self.err: Optional[Exception] = None
+
+
+class CrossCallerBatcher:
+    def __init__(self, engine, window_ms: float = 2.0,
+                 max_batch: Optional[int] = None) -> None:
+        self.engine = engine
+        self.window_s = window_ms / 1000.0
+        self.max_batch = max_batch or engine.batch_cfg.max_batch
+        self._lock = threading.Lock()
+        self._pending: List[_Req] = []
+        self._leader_active = False
+        self.dispatches = 0  # instrumentation: device dispatch count
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        req = _Req(x)
+        with self._lock:
+            self._pending.append(req)
+            is_leader = not self._leader_active
+            if is_leader:
+                self._leader_active = True
+        if is_leader:
+            time.sleep(self.window_s)
+            with self._lock:
+                batch = self._pending
+                self._pending = []
+                self._leader_active = False
+            self._run(batch)
+        else:
+            req.event.wait()
+        if req.err is not None:
+            raise req.err
+        assert req.out is not None
+        return req.out
+
+    def _run(self, batch: List[_Req]) -> None:
+        try:
+            xs = np.concatenate([r.x for r in batch]) if len(batch) > 1 else batch[0].x
+            outs = []
+            # Chunk if concurrent callers exceed the engine's largest bucket.
+            for i in range(0, xs.shape[0], self.max_batch):
+                outs.append(self.engine.predict(xs[i : i + self.max_batch]))
+                self.dispatches += 1
+            out = np.concatenate(outs) if len(outs) > 1 else outs[0]
+            off = 0
+            for r in batch:
+                n = r.x.shape[0]
+                r.out = out[off : off + n]
+                off += n
+        except Exception as e:
+            for r in batch:
+                r.err = e
+        finally:
+            for r in batch:
+                r.event.set()

@@ -65,11 +65,20 @@ class InferenceWorker:
         engine: Optional[InferenceEngine] = None,
         port: int = 50051,
         max_workers: int = 8,
+        cross_batch_ms: float = 0.0,
     ) -> None:
         self.model_cfg = model or ModelConfig()
         self.engine = engine or shared_engine(
             self.model_cfg, sharding or ShardingConfig(), batch or BatchConfig()
         )
+        # cross_batch_ms > 0: coalesce concurrent Predict RPCs from different
+        # callers into one device dispatch (serve/batcher.py). Off by default
+        # — single-caller deployments shouldn't pay the window latency.
+        self._batcher = None
+        if cross_batch_ms > 0:
+            from storm_tpu.serve.batcher import CrossCallerBatcher
+
+            self._batcher = CrossCallerBatcher(self.engine, window_ms=cross_batch_ms)
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=[
@@ -94,11 +103,16 @@ class InferenceWorker:
                 f"{self.engine.input_shape}",
             )
         try:
-            out = self.engine.predict(np.asarray(x, np.float32))
+            out = self._run_predict(np.asarray(x, np.float32))
         except Exception as e:  # pragma: no cover - engine failure
             log.exception("predict failed")
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         return encode_tensor(out)
+
+    def _run_predict(self, x: np.ndarray) -> np.ndarray:
+        if self._batcher is not None:
+            return self._batcher.predict(x)
+        return self.engine.predict(x)
 
     def _predict_json(self, request: bytes, context: grpc.ServicerContext) -> bytes:
         try:
@@ -111,7 +125,7 @@ class InferenceWorker:
         except SchemaError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         try:
-            out = self.engine.predict(inst.data)
+            out = self._run_predict(inst.data)
         except Exception as e:  # pragma: no cover
             log.exception("predict failed")
             context.abort(grpc.StatusCode.INTERNAL, str(e))

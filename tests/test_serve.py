@@ -126,3 +126,83 @@ def test_remote_bolt_topology(worker, run):
     assert len(outs) == 5
     for r in outs:
         assert decode_predictions(r.value).data.shape == (1, 10)
+
+
+# ---- cross-caller batching ---------------------------------------------------
+
+
+def test_cross_caller_batching_coalesces():
+    """8 concurrent clients -> fewer device dispatches than calls, same
+    results as unbatched."""
+    import threading
+
+    w = InferenceWorker(
+        ModelConfig(name="lenet5", dtype="float32", input_shape=(28, 28, 1)),
+        ShardingConfig(data_parallel=1),
+        BatchConfig(max_batch=64, buckets=(64,)),
+        port=0,
+        cross_batch_ms=50.0,
+    ).start()
+    try:
+        xs = [np.random.rand(2, 28, 28, 1).astype(np.float32) for _ in range(8)]
+        want = [w.engine.predict(x) for x in xs]
+        w._batcher.dispatches = 0
+
+        outs = [None] * 8
+        errs = []
+
+        def call(i):
+            try:
+                with InferenceClient(f"localhost:{w.port}") as c:
+                    outs[i] = c.predict(xs[i])
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert not errs
+        for got, exp in zip(outs, want):
+            np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-5)
+        assert 1 <= w._batcher.dispatches < 8
+    finally:
+        w.stop()
+
+
+def test_cross_caller_batcher_chunks_oversize():
+    from storm_tpu.serve.batcher import CrossCallerBatcher
+
+    class FakeEngine:
+        class batch_cfg:
+            max_batch = 4
+
+        def __init__(self):
+            self.calls = []
+
+        def predict(self, x):
+            self.calls.append(x.shape[0])
+            return x.reshape(x.shape[0], -1)[:, :3]
+
+    eng = FakeEngine()
+    b = CrossCallerBatcher(eng, window_ms=1.0)
+    x = np.random.rand(10, 2, 2, 1).astype(np.float32)
+    out = b.predict(x)
+    assert out.shape == (10, 3)
+    assert eng.calls == [4, 4, 2]
+
+
+def test_cross_caller_batcher_propagates_errors():
+    from storm_tpu.serve.batcher import CrossCallerBatcher
+
+    class BoomEngine:
+        class batch_cfg:
+            max_batch = 8
+
+        def predict(self, x):
+            raise RuntimeError("boom")
+
+    b = CrossCallerBatcher(BoomEngine(), window_ms=1.0)
+    with pytest.raises(RuntimeError, match="boom"):
+        b.predict(np.zeros((2, 2), np.float32))
