@@ -94,17 +94,18 @@ def decode_instances(payload: str | bytes, *, ts: float = 0.0) -> Instances:
     ``instObj.getInstances()`` (InferenceBolt.java:76-77), producing a dense
     float32 array. Raises :class:`SchemaError` on any contract violation.
     """
-    if isinstance(payload, bytes):
-        try:
-            payload = payload.decode("utf-8")
-        except UnicodeDecodeError as e:
-            raise SchemaError(f"payload is not UTF-8: {e}") from e
-
     # Fast path: native C++ parser (built lazily; falls back transparently).
+    # bytes go to the native parser as-is — no utf-8 decode/encode round
+    # trip on the hot path; the parser validates the JSON structurally.
     from storm_tpu.native import parse_instances_native
 
     arr = parse_instances_native(payload)
     if arr is None:
+        if isinstance(payload, bytes):
+            try:
+                payload = payload.decode("utf-8")
+            except UnicodeDecodeError as e:
+                raise SchemaError(f"payload is not UTF-8: {e}") from e
         try:
             obj = json.loads(payload)
         except json.JSONDecodeError as e:
@@ -131,6 +132,14 @@ def encode_predictions(preds: Predictions | np.ndarray) -> str:
     arr = preds.data if isinstance(preds, Predictions) else np.asarray(preds)
     if arr.ndim == 1:
         arr = arr[None, :]
+
+    # Fast path: native C++ serializer (falls back transparently).
+    from storm_tpu.native import format_predictions_native
+
+    if arr.ndim == 2 and arr.dtype in (np.float32, np.float64):
+        s = format_predictions_native(arr)
+        if s is not None:
+            return s
     return json.dumps({"predictions": arr.astype(np.float64).round(7).tolist()})
 
 

@@ -49,6 +49,16 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.stpu_free.restype = None
         lib.stpu_free.argtypes = [ctypes.c_void_p]
+        try:
+            lib.stpu_format_predictions.restype = ctypes.c_void_p
+            lib.stpu_format_predictions.argtypes = [
+                ctypes.c_void_p,  # float* data
+                ctypes.c_int64,  # n
+                ctypes.c_int64,  # k
+                ctypes.POINTER(ctypes.c_size_t),  # out length
+            ]
+        except AttributeError:  # stale .so without the serializer
+            pass
         _lib = lib
     except OSError:
         _lib = None
@@ -99,3 +109,26 @@ def parse_instances_native(payload: str | bytes) -> Optional[np.ndarray]:
     ctypes.memmove(out.ctypes.data, ptr, n * 4)
     lib.stpu_free(ptr)
     return out.reshape(shp)
+
+
+def format_predictions_native(arr: np.ndarray) -> Optional[str]:
+    """Serialize an (N, K) float array to ``{"predictions": [[...]]}`` with
+    the C++ writer. Returns ``None`` when unavailable (caller falls back to
+    the Python path)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "stpu_format_predictions"):
+        return None
+    a = np.ascontiguousarray(arr, dtype=np.float32)
+    if a.ndim == 1:
+        a = a[None, :]
+    if a.ndim != 2:
+        return None
+    length = ctypes.c_size_t(0)
+    ptr = lib.stpu_format_predictions(
+        a.ctypes.data, a.shape[0], a.shape[1], ctypes.byref(length)
+    )
+    if not ptr:
+        return None
+    s = ctypes.string_at(ptr, length.value).decode("ascii")
+    lib.stpu_free(ptr)
+    return s

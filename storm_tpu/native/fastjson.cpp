@@ -20,6 +20,7 @@
 
 #include <cctype>
 #include <charconv>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -332,5 +333,45 @@ float* stpu_parse_instances(const char* buf, size_t len, int64_t* shape_out,
 }
 
 void stpu_free(void* p) { std::free(p); }
+
+// Serialize an (n, k) float32 matrix to the {"predictions": [[...]]} wire
+// form (PredObj.java:9-17 equivalent). Numbers are rounded to 7 decimal
+// places then printed with shortest round-trip (std::to_chars), matching the
+// Python path's json.dumps(round(float64, 7)) to within the rounding-mode
+// ulp. Returns a malloc'd buffer (caller frees via stpu_free); *len_out gets
+// the byte length. Non-finite values are emitted as JSON-python tokens
+// (NaN/Infinity), mirroring json.dumps defaults.
+char* stpu_format_predictions(const float* data, int64_t n, int64_t k,
+                              size_t* len_out) {
+  std::string s;
+  s.reserve(static_cast<size_t>(n * k) * 12 + 24);
+  s += "{\"predictions\": [";
+  char buf[32];
+  for (int64_t i = 0; i < n; ++i) {
+    s += (i ? ", [" : "[");
+    for (int64_t j = 0; j < k; ++j) {
+      if (j) s += ", ";
+      double v = static_cast<double>(data[i * k + j]);
+      if (v != v) {
+        s += "NaN";
+        continue;
+      }
+      if (v > 1.7e308 || v < -1.7e308) {
+        s += (v > 0 ? "Infinity" : "-Infinity");
+        continue;
+      }
+      double r = std::round(v * 1e7) / 1e7;
+      auto res = std::to_chars(buf, buf + sizeof(buf), r);
+      s.append(buf, res.ptr - buf);
+    }
+    s += "]";
+  }
+  s += "]}";
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (!out) return nullptr;
+  std::memcpy(out, s.data(), s.size() + 1);
+  *len_out = s.size();
+  return out;
+}
 
 }  // extern "C"

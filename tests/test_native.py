@@ -78,3 +78,55 @@ def test_python_fallback_when_disabled(native_lib, monkeypatch):
     assert decode_instances('{"instances": [[1.0, 2.0]]}').data.shape == (1, 2)
     n._load_attempted = False
     n._lib = None
+
+
+# ---- native predictions serializer -------------------------------------------
+
+
+def test_format_predictions_native_roundtrip():
+    from storm_tpu.api.schema import decode_predictions
+    from storm_tpu.native import format_predictions_native, native_available
+
+    if not native_available():
+        pytest.skip("native library not built")
+    a = np.array(
+        [[0.1234567891, 0.5, 1e-9, 123456.789], [1.0, 0.0, -0.25, 3.14159265]],
+        np.float32,
+    )
+    s = format_predictions_native(a)
+    assert s is not None and s.startswith('{"predictions": [[')
+    back = decode_predictions(s)
+    np.testing.assert_allclose(back.data, a, rtol=1e-6, atol=1e-7)
+
+
+def test_format_predictions_matches_python_path(monkeypatch):
+    from storm_tpu.api import schema
+    from storm_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native library not built")
+    rng = np.random.RandomState(0)
+    a = rng.rand(4, 10).astype(np.float32)
+    s_native = schema.encode_predictions(a)
+    # Force the Python path and compare numerically.
+    monkeypatch.setattr(
+        "storm_tpu.native.format_predictions_native", lambda arr: None
+    )
+    s_py = schema.encode_predictions(a)
+    d1 = schema.decode_predictions(s_native).data
+    d2 = schema.decode_predictions(s_py).data
+    np.testing.assert_allclose(d1, d2, rtol=1e-6, atol=1e-7)
+
+
+def test_format_predictions_1d_and_nonfinite():
+    from storm_tpu.api.schema import decode_predictions
+    from storm_tpu.native import format_predictions_native, native_available
+
+    if not native_available():
+        pytest.skip("native library not built")
+    s = format_predictions_native(np.array([0.25, 0.75], np.float32))
+    assert decode_predictions(s).data.shape == (1, 2)
+    s = format_predictions_native(np.array([[np.nan, np.inf, -np.inf]], np.float32))
+    # json module accepts NaN/Infinity tokens (python json.dumps emits them too)
+    back = decode_predictions(s).data
+    assert np.isnan(back[0, 0]) and np.isinf(back[0, 1]) and back[0, 2] < 0
