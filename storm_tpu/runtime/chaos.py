@@ -1,0 +1,105 @@
+"""Fault injection for topology tests (chaos hooks).
+
+The reference has *no* fault injection anywhere (SURVEY.md §5.3); its
+fault-tolerance story — supervisors restart dead workers, tuple trees replay
+on failure — is inherited from Storm and never exercised in-tree. This
+module makes those paths testable in the in-process cluster:
+
+- :meth:`ChaosMonkey.crash_bolt` / :meth:`crash_spout` kill a live executor
+  task the way a framework bug (not a user exception) would: the injected
+  :class:`ChaosCrash` derives from ``BaseException``, so the executor loop's
+  ``except Exception`` tuple-failure handling does NOT catch it — the task
+  dies, and the supervisor sweep must detect and replace it
+  (runtime/cluster.py:_supervise);
+- in-flight tuples on the crashed executor are recovered by the ack ledger's
+  timeout sweep -> spout replay (at-least-once), which tests assert on;
+- :meth:`run` drives a random kill loop for soak-style chaos tests.
+
+Test-only by design: it reaches into live executors. Not imported by any
+production path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Optional
+
+
+class ChaosCrash(BaseException):
+    """Injected executor death. BaseException on purpose: user-code errors
+    (Exception) are caught and turn into tuple failures; this must not be."""
+
+
+class ChaosMonkey:
+    def __init__(self, runtime, seed: int = 0) -> None:
+        self.rt = runtime
+        self.rng = random.Random(seed)
+        self.kills = 0
+
+    # ---- targeted injection --------------------------------------------------
+
+    def crash_bolt(self, component_id: str, index: int = 0) -> None:
+        """Kill bolt executor ``component_id[index]`` on its next tuple."""
+        e = self.rt.bolt_execs[component_id][index]
+
+        async def boom(_t):
+            raise ChaosCrash(f"chaos: {component_id}[{index}]")
+
+        e.bolt.execute = boom
+        self.kills += 1
+
+    def crash_spout(self, component_id: str, index: int = 0) -> None:
+        """Kill spout executor ``component_id[index]`` on its next pull."""
+        e = self.rt.spout_execs[component_id][index]
+
+        async def boom():
+            raise ChaosCrash(f"chaos: {component_id}[{index}]")
+
+        e.spout.next_tuple = boom
+        self.kills += 1
+
+    def crash_random(self) -> str:
+        """Kill one uniformly-random executor; returns its id."""
+        targets = [
+            ("bolt", cid, i)
+            for cid, execs in self.rt.bolt_execs.items()
+            for i in range(len(execs))
+        ] + [
+            ("spout", cid, i)
+            for cid, execs in self.rt.spout_execs.items()
+            for i in range(len(execs))
+        ]
+        kind, cid, i = self.rng.choice(targets)
+        if kind == "bolt":
+            self.crash_bolt(cid, i)
+        else:
+            self.crash_spout(cid, i)
+        return f"{cid}[{i}]"
+
+    # ---- soak loop -----------------------------------------------------------
+
+    async def run(
+        self,
+        duration_s: float,
+        interval_s: float = 0.5,
+        components: Optional[list] = None,
+    ) -> int:
+        """Kill a random executor every ``interval_s`` for ``duration_s``.
+        Restricts targets to ``components`` when given. Returns kill count."""
+        end = asyncio.get_event_loop().time() + duration_s
+        while asyncio.get_event_loop().time() < end:
+            await asyncio.sleep(interval_s)
+            if components:
+                cid = self.rng.choice(components)
+                if cid in self.rt.bolt_execs:
+                    self.crash_bolt(
+                        cid, self.rng.randrange(len(self.rt.bolt_execs[cid]))
+                    )
+                else:
+                    self.crash_spout(
+                        cid, self.rng.randrange(len(self.rt.spout_execs[cid]))
+                    )
+            else:
+                self.crash_random()
+        return self.kills
