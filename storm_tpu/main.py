@@ -121,7 +121,8 @@ def _load_config(args) -> Config:
     return cfg
 
 
-async def _run_daemon(name: str, cfg: Config, duration: float) -> None:
+async def _run_daemon(name: str, cfg: Config, duration: float,
+                      autoscale_target_ms: float = 0.0) -> None:
     from storm_tpu.runtime.cluster import AsyncLocalCluster
 
     broker = _make_broker(cfg)
@@ -133,8 +134,22 @@ async def _run_daemon(name: str, cfg: Config, duration: float) -> None:
         desc = cfg.model.name
     cluster = AsyncLocalCluster()
     rt = await cluster.submit(name, cfg, topo)
+    scaler = None
+    if autoscale_target_ms > 0:
+        from storm_tpu.runtime.autoscale import Autoscaler, AutoscalePolicy
+
+        scaler = Autoscaler(
+            rt,
+            AutoscalePolicy(
+                component="inference-bolt",
+                latency_source="kafka-bolt",
+                high_ms=autoscale_target_ms,
+                low_ms=autoscale_target_ms / 4,
+            ),
+        ).start()
     print(f"topology {name!r} running "
-          f"(model={desc}, broker={cfg.broker.kind})", file=sys.stderr)
+          f"(model={desc}, broker={cfg.broker.kind}"
+          f"{', autoscaling' if scaler else ''})", file=sys.stderr)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -145,6 +160,8 @@ async def _run_daemon(name: str, cfg: Config, duration: float) -> None:
     await stop.wait()
 
     print("draining...", file=sys.stderr)
+    if scaler is not None:
+        await scaler.stop()
     await rt.deactivate()
     await rt.drain(timeout_s=30)
     snap = rt.metrics.snapshot()
@@ -167,6 +184,11 @@ def main(argv=None) -> int:
     runp.add_argument("--duration", type=float, default=0.0,
                       help="run window in seconds (0 = until signal); the "
                            "reference hard-killed after 3600s")
+    runp.add_argument("--autoscale-target-ms", type=float, default=0.0,
+                      help="autoscale inference parallelism to keep e2e p50 "
+                           "under this latency (0 = off); the runtime "
+                           "equivalent of the reference's rebuild-with-more-"
+                           "bolts scaling thesis (README.md:13-14)")
 
     servep = sub.add_parser("serve", help="run the gRPC TPU inference worker")
     servep.add_argument("--config", help="TOML/JSON config file")
@@ -192,7 +214,8 @@ def main(argv=None) -> int:
                 "are ignored",
                 file=sys.stderr,
             )
-        asyncio.run(_run_daemon(args.name, cfg, args.duration))
+        asyncio.run(_run_daemon(args.name, cfg, args.duration,
+                                args.autoscale_target_ms))
         return 0
 
     if args.cmd == "serve":
