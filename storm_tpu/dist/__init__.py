@@ -1,0 +1,28 @@
+"""Multi-host distributed runtime: worker processes + gRPC tuple transport.
+
+The reference scales across 8 Storm worker *processes* with Netty moving
+tuples between them and ZooKeeper/Nimbus coordinating (SURVEY.md §2.5:
+"in-process asyncio queues within a host; gRPC over DCN between hosts").
+This package is that second half:
+
+- :mod:`storm_tpu.dist.worker` — a worker process hosting the executors of
+  its assigned components; remote components' inboxes are gRPC proxies, so
+  the single-host `OutputCollector` works unchanged across hosts;
+- :mod:`storm_tpu.dist.transport` — the wire envelopes (tuple batches, ack
+  ops, control) over raw-bytes gRPC;
+- :mod:`storm_tpu.dist.controller` — Nimbus-equivalent: spawns or connects
+  workers, ships config + placement, runs the two-phase start (bolts
+  everywhere, then spouts), aggregates metrics, drains, kills;
+- ack routing: every tuple id carries its origin worker in the top 8 bits
+  (runtime/tuples.py:set_worker_tag), so XOR acks flow straight back to the
+  root's ledger owner with no coordination service.
+
+TPU note: each worker process owns its own JAX runtime — on a multi-host
+slice this is one worker per host, with the in-model parallelism (dp/tp/
+pp/sp/ep, storm_tpu/parallel) spanning that host's chips via its Mesh, and
+topology-level scale-out spanning hosts via this package.
+"""
+
+from storm_tpu.dist.controller import DistCluster
+
+__all__ = ["DistCluster"]

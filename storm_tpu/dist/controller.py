@@ -1,0 +1,188 @@
+"""Controller: the Nimbus-equivalent for the distributed runtime.
+
+The reference submits through ``StormSubmitter``/``NimbusClient`` over
+Thrift and lets Nimbus schedule executors onto 8 workers
+(MainTopology.java:69-77, SURVEY.md §3.1). Here the controller:
+
+- spawns worker processes on this host (or attaches to pre-started remote
+  workers by address — the multi-host path),
+- ships each worker the topology *recipe* (Config dict + builder name +
+  placement + peer table) over the Control RPC — workers rebuild the
+  topology locally, so no code/object pickling crosses the wire,
+- two-phase start: bolts everywhere first, then spouts (downstream ready
+  before data flows — same ordering the single-host runtime uses),
+- aggregates metrics/health, and drives deactivate -> drain -> kill.
+
+Placement: explicit ``{component_id: worker_idx}``, or round-robin when
+omitted (spouts pinned to worker 0 so ledgers sit with their spouts).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from storm_tpu.config import Config
+from storm_tpu.dist.transport import WorkerClient
+
+
+class DistCluster:
+    def __init__(
+        self,
+        n_workers: int = 2,
+        addrs: Optional[List[str]] = None,
+        env: Optional[dict] = None,
+    ) -> None:
+        """Spawn ``n_workers`` local worker processes, or attach to
+        ``addrs`` (["host:port", ...]) if given."""
+        self.procs: List[subprocess.Popen] = []
+        self.clients: List[WorkerClient] = []
+        self._stderr_files: List = []
+        if addrs:
+            for addr in addrs:
+                self.clients.append(WorkerClient(addr))
+        else:
+            import os
+            import tempfile
+
+            for i in range(n_workers):
+                # stderr to a tempfile (not PIPE: an unread pipe would block
+                # a chatty worker; not DEVNULL: startup crashes must be
+                # diagnosable).
+                errf = tempfile.TemporaryFile()
+                self._stderr_files.append(errf)
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "storm_tpu.dist.worker",
+                     "--port", "0", "--index", str(i)],
+                    stdout=subprocess.PIPE,
+                    stderr=errf,
+                    env={**os.environ, **(env or {})},
+                )
+                self.procs.append(proc)
+                # Worker prints one JSON ready-line with its bound port.
+                line = proc.stdout.readline().decode()
+                if not line.strip():
+                    errf.seek(0)
+                    tail = errf.read()[-4000:].decode("utf-8", "replace")
+                    raise RuntimeError(
+                        f"worker {i} died during startup; stderr tail:\n{tail}"
+                    )
+                info = json.loads(line)
+                self.clients.append(WorkerClient(f"127.0.0.1:{info['port']}"))
+        for c in self.clients:
+            c.wait_ready()
+        self.peers = {i: c.target for i, c in enumerate(self.clients)}
+        self._placement: Dict[str, int] = {}
+
+    # ---- topology lifecycle --------------------------------------------------
+
+    def submit(
+        self,
+        name: str,
+        cfg: Config,
+        placement: Optional[Dict[str, int]] = None,
+        builder: str = "standard",
+    ) -> Dict[str, int]:
+        """Ship the recipe to every worker and start it (two-phase).
+        Returns the placement used."""
+        if placement is None:
+            placement = self._auto_place(cfg, builder)
+        bad = {c: w for c, w in placement.items() if w >= len(self.clients)}
+        if bad:
+            raise ValueError(f"placement onto unknown workers: {bad}")
+        self._placement = placement
+        for c in self.clients:
+            c.control(
+                "submit",
+                name=name,
+                config=cfg.to_dict(),
+                placement=placement,
+                peers=self.peers,
+                builder=builder,
+            )
+        for c in self.clients:
+            c.control("start_bolts")
+        for c in self.clients:
+            c.control("start_spouts")
+        return placement
+
+    def _auto_place(self, cfg: Config, builder: str) -> Dict[str, int]:
+        """Spouts on worker 0 (ledger lives with its spout); bolts
+        round-robin over the rest (or worker 0 when single-worker)."""
+        from storm_tpu.main import (
+            build_multi_model_topology,
+            build_standard_topology,
+        )
+        from storm_tpu.connectors import MemoryBroker
+
+        build = (build_multi_model_topology if builder == "multi"
+                 else build_standard_topology)
+        topo = build(cfg, MemoryBroker())
+        placement: Dict[str, int] = {}
+        n = len(self.clients)
+        rr = 1 % n
+        for spec in topo.specs.values():
+            if spec.is_spout:
+                placement[spec.component_id] = 0
+            else:
+                placement[spec.component_id] = rr
+                rr = (rr + 1) % n or (1 % n)
+        return placement
+
+    # ---- observation ---------------------------------------------------------
+
+    def metrics(self) -> Dict[str, dict]:
+        """Merged metrics: each component's numbers come from the worker
+        that hosts it."""
+        merged: Dict[str, dict] = {}
+        for i, c in enumerate(self.clients):
+            snap = c.control("metrics")["metrics"]
+            for comp, vals in snap.items():
+                if self._placement.get(comp, 0) == i or comp not in merged:
+                    merged[comp] = vals
+        return merged
+
+    def health(self) -> Dict[int, dict]:
+        return {i: c.control("health")["health"]
+                for i, c in enumerate(self.clients)}
+
+    # ---- teardown ------------------------------------------------------------
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        for c in self.clients:
+            c.control("deactivate")
+        ok = True
+        for c in self.clients:
+            ok = c.control("drain", timeout_s=timeout_s).get("ok", False) and ok
+        return ok
+
+    def kill(self, wait_secs: float = 0.0) -> None:
+        for c in self.clients:
+            c.control("kill", wait_secs=wait_secs)
+
+    def shutdown(self) -> None:
+        for c in self.clients:
+            try:
+                c.control("shutdown", timeout=5.0)
+            except Exception:
+                pass
+            c.close()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in self._stderr_files:
+            f.close()
+        self._stderr_files.clear()
+        self.procs.clear()
+        self.clients.clear()
+
+    def __enter__(self) -> "DistCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -1,0 +1,149 @@
+"""Wire envelopes + gRPC plumbing for the distributed runtime.
+
+Raw-bytes gRPC (no protoc codegen, same pattern as storm_tpu/serve): three
+methods on service ``storm_tpu.Dist``:
+
+- ``Deliver`` — a batch of tuples for components hosted on the receiving
+  worker. The RPC returns only after every tuple is enqueued into its
+  executor inbox, so bounded-inbox backpressure propagates across hosts.
+- ``Ack`` — a batch of ledger ops (xor / fail_root) routed to the worker
+  whose spout owns the tuple tree (id's top byte, tuples.owner_of).
+- ``Control`` — controller -> worker RPCs: submit / start / metrics /
+  drain / kill / ping, JSON in, JSON out.
+
+Envelope notes: ids are 64-bit and JSON numbers lose integer precision past
+2^53, so ids travel as decimal strings. ``root_ts`` is a local
+``perf_counter`` value with a per-process epoch, so it crosses the wire as
+*age* (sender_now - root_ts) and is rebased on arrival — e2e latency
+histograms on remote workers stay meaningful (minus network transit, which
+is part of what they should measure anyway).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Iterable, List, Tuple as Tup
+
+import grpc
+
+from storm_tpu.runtime.tuples import Tuple
+
+SERVICE = "storm_tpu.Dist"
+
+_OPTS = [
+    ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+    ("grpc.max_send_message_length", 64 * 1024 * 1024),
+]
+
+
+# ---- tuple envelope ----------------------------------------------------------
+
+
+def encode_tuple(t: Tuple, now: float) -> list:
+    return [
+        list(t.values),
+        list(t.fields),
+        t.stream,
+        t.source_component,
+        t.source_task,
+        str(t.edge_id),
+        [str(a) for a in t.anchors],
+        now - t.root_ts,  # age, rebased on arrival
+    ]
+
+
+def decode_tuple(enc: list, now: float) -> Tuple:
+    values, fields, stream, src, src_task, edge, anchors, age = enc
+    return Tuple(
+        values=values,
+        fields=tuple(fields),
+        source_component=src,
+        source_task=src_task,
+        stream=stream,
+        edge_id=int(edge),
+        anchors=frozenset(int(a) for a in anchors),
+        root_ts=now - age,
+    )
+
+
+def encode_deliveries(deliveries: Iterable[Tup[str, int, Tuple]]) -> bytes:
+    """deliveries: (component_id, task_index, tuple) triples."""
+    now = time.perf_counter()
+    return json.dumps(
+        [[c, i, encode_tuple(t, now)] for c, i, t in deliveries]
+    ).encode("utf-8")
+
+
+def decode_deliveries(payload: bytes) -> List[Tup[str, int, Tuple]]:
+    now = time.perf_counter()
+    return [
+        (c, i, decode_tuple(enc, now)) for c, i, enc in json.loads(payload)
+    ]
+
+
+def encode_acks(ops: Iterable[Tup[str, int, int]]) -> bytes:
+    """ops: ('xor'|'fail', root_id, edge_id) triples."""
+    return json.dumps([[op, str(r), str(e)] for op, r, e in ops]).encode("utf-8")
+
+
+def decode_acks(payload: bytes) -> List[Tup[str, int, int]]:
+    return [(op, int(r), int(e)) for op, r, e in json.loads(payload)]
+
+
+# ---- client ------------------------------------------------------------------
+
+
+class WorkerClient:
+    """Channel to one worker's Dist service."""
+
+    def __init__(self, target: str) -> None:
+        self.target = target
+        self._channel = grpc.insecure_channel(target, options=_OPTS)
+        self._deliver = self._channel.unary_unary(f"/{SERVICE}/Deliver")
+        self._ack = self._channel.unary_unary(f"/{SERVICE}/Ack")
+        self._control = self._channel.unary_unary(f"/{SERVICE}/Control")
+
+    def deliver(self, payload: bytes, timeout: float = 60.0) -> None:
+        self._deliver(payload, timeout=timeout)
+
+    def ack(self, payload: bytes, timeout: float = 60.0) -> None:
+        self._ack(payload, timeout=timeout)
+
+    def control(self, cmd: str, timeout: float = 120.0, **kwargs: Any) -> Dict:
+        req = json.dumps({"cmd": cmd, **kwargs}).encode("utf-8")
+        resp = json.loads(self._control(req, timeout=timeout))
+        if resp.get("error"):
+            raise RuntimeError(f"{self.target} {cmd}: {resp['error']}")
+        return resp
+
+    def wait_ready(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self.control("ping", timeout=2.0)
+                return
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"worker {self.target} never became ready")
+                time.sleep(0.1)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class DistHandler(grpc.GenericRpcHandler):
+    """Routes the three methods to a worker's callbacks."""
+
+    def __init__(self, deliver_fn, ack_fn, control_fn) -> None:
+        self._methods = {
+            f"/{SERVICE}/Deliver": deliver_fn,
+            f"/{SERVICE}/Ack": ack_fn,
+            f"/{SERVICE}/Control": control_fn,
+        }
+
+    def service(self, call_details):
+        fn = self._methods.get(call_details.method)
+        if fn is None:
+            return None
+        return grpc.unary_unary_rpc_method_handler(fn)

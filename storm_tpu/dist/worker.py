@@ -1,0 +1,462 @@
+"""Distributed worker process: hosts the executors of its assigned
+components; everything else is reached over gRPC.
+
+The Storm-worker equivalent (SURVEY.md §1 layer 1: 8 worker processes,
+MainTopology.java:25,66 — tuples cross workers via Netty; here via gRPC):
+
+- :class:`DistRuntime` extends the single-host ``TopologyRuntime``: local
+  components get real executors; components placed on other workers get a
+  ``TargetGroup`` of :class:`RemoteInbox` proxies, so ``OutputCollector``
+  routing/grouping/anchoring code is byte-identical in both modes;
+- :class:`PeerSender` batches tuple deliveries and ack ops per peer and
+  ships them from a background task (network never blocks an executor);
+- :class:`DistLedger` routes XOR acks: ids tagged with this worker's index
+  apply to the local ledger, others are forwarded to their owner;
+- run as ``python -m storm_tpu.dist.worker --port P --index I``; the
+  controller drives it over the Control RPC.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+import threading
+import time
+from concurrent import futures
+from typing import Any, Dict, List, Optional, Tuple as Tup
+
+import grpc
+
+from storm_tpu.config import Config
+from storm_tpu.dist import transport
+from storm_tpu.dist.transport import DistHandler, WorkerClient
+from storm_tpu.runtime.acker import AckLedger
+from storm_tpu.runtime.cluster import TargetGroup, TopologyRuntime
+from storm_tpu.runtime.executor import BoltExecutor, SpoutExecutor, clone_component
+from storm_tpu.runtime.tuples import Tuple, owner_of, set_worker_tag
+
+log = logging.getLogger("storm_tpu.dist")
+
+
+# ---- outbound ----------------------------------------------------------------
+
+
+class PeerSender:
+    """Per-peer outbound queue: batches tuples/acks, sends via a worker
+    thread so gRPC never blocks the event loop. Backpressure is end-to-end,
+    not local: the queue is unbounded (see __init__), volume is bounded by
+    ``max_spout_pending`` on the root spouts, and the receiving side's
+    `Deliver` RPC blocks until its executor inboxes accept the batch."""
+
+    #: soft byte cap per Deliver RPC, well under the 64MB gRPC message limit
+    MAX_BATCH_BYTES = 8 * 1024 * 1024
+    MAX_BATCH_ITEMS = 512
+    RETRIES = 3
+
+    def __init__(self, addr: str) -> None:
+        self.client = WorkerClient(addr)
+        # Unbounded on purpose: acks must never lose to backpressure (a
+        # dropped ack = timeout + replay), and tuple volume is already
+        # bounded end-to-end by max_spout_pending on the root spouts plus
+        # the blocking Deliver RPC on the receiving side.
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self._loop())
+
+    async def put_tuple(self, component: str, task: int, t: Tuple) -> None:
+        await self.queue.put(("t", component, task, t))
+
+    def put_ack_nowait(self, op: str, root: int, edge: int) -> None:
+        self.queue.put_nowait(("a", op, root, edge))
+
+    @staticmethod
+    def _approx_bytes(item) -> int:
+        if item[0] == "a":
+            return 48
+        t = item[3]
+        return 96 + sum(len(v) if isinstance(v, (str, bytes)) else 16
+                        for v in t.values)
+
+    async def _loop(self) -> None:
+        while True:
+            item = await self.queue.get()
+            items = [item]
+            nbytes = self._approx_bytes(item)
+            # Opportunistic batch, capped by count AND bytes so one RPC can
+            # never exceed the gRPC message limit (large image tuples).
+            while len(items) < self.MAX_BATCH_ITEMS and nbytes < self.MAX_BATCH_BYTES:
+                try:
+                    nxt = self.queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                items.append(nxt)
+                nbytes += self._approx_bytes(nxt)
+            tuples = [(c, i, t) for kind, c, i, t in
+                      (x for x in items if x[0] == "t")]
+            acks = [(op, r, e) for kind, op, r, e in
+                    (x for x in items if x[0] == "a")]
+            try:
+                if acks:
+                    await self._send(self.client.ack, transport.encode_acks(acks))
+                if tuples:
+                    await self._send(
+                        self.client.deliver, transport.encode_deliveries(tuples)
+                    )
+            except Exception as e:
+                # Exhausted retries: the affected trees hit the ledger
+                # timeout and replay from the spout (at-least-once, same as
+                # a lost Netty transfer in Storm).
+                log.warning("peer %s send failed: %s", self.client.target, e)
+
+    async def _send(self, fn, payload: bytes) -> None:
+        for attempt in range(self.RETRIES):
+            try:
+                await asyncio.to_thread(fn, payload)
+                return
+            except Exception:
+                if attempt == self.RETRIES - 1:
+                    raise
+                await asyncio.sleep(0.1 * 2**attempt)
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self.client.close()
+
+
+class RemoteInbox:
+    """Queue look-alike for a remote executor's inbox."""
+
+    maxsize = 0  # health/autoscale treat remote inboxes as opaque
+
+    def __init__(self, sender: PeerSender, component: str, task: int) -> None:
+        self._sender = sender
+        self._component = component
+        self._task = task
+
+    async def put(self, t: Tuple) -> None:
+        await self._sender.put_tuple(self._component, self._task, t)
+
+    def put_nowait(self, t: Tuple) -> None:  # tick tuples never cross hosts
+        raise RuntimeError("put_nowait on a remote inbox")
+
+    def qsize(self) -> int:
+        return 0
+
+
+# ---- ack routing -------------------------------------------------------------
+
+
+class DistLedger:
+    """AckLedger facade routing ops by the id's owner tag."""
+
+    def __init__(self, base: AckLedger, worker_idx: int,
+                 senders: Dict[int, PeerSender]) -> None:
+        self._base = base
+        self._idx = worker_idx
+        self._senders = senders
+
+    # local-only surface used by the runtime
+    @property
+    def inflight(self) -> int:
+        return self._base.inflight
+
+    @property
+    def acked(self) -> int:
+        return self._base.acked
+
+    @property
+    def failed(self) -> int:
+        return self._base.failed
+
+    def init_root(self, *a, **kw) -> None:
+        self._base.init_root(*a, **kw)
+
+    def sweep(self) -> int:
+        return self._base.sweep()
+
+    # routed surface
+    def xor(self, root_id: int, edge_id: int) -> None:
+        owner = owner_of(root_id)
+        if owner == self._idx or owner not in self._senders:
+            self._base.xor(root_id, edge_id)
+        else:
+            self._senders[owner].put_ack_nowait("xor", root_id, edge_id)
+
+    def fail_root(self, root_id: int) -> None:
+        owner = owner_of(root_id)
+        if owner == self._idx or owner not in self._senders:
+            self._base.fail_root(root_id)
+        else:
+            self._senders[owner].put_ack_nowait("fail", root_id, 0)
+
+
+# ---- the runtime -------------------------------------------------------------
+
+
+class DistRuntime(TopologyRuntime):
+    """TopologyRuntime hosting only the components placed on this worker."""
+
+    def __init__(
+        self,
+        name: str,
+        topology,
+        config: Config,
+        worker_idx: int,
+        placement: Dict[str, int],
+        peers: Dict[int, str],
+    ) -> None:
+        super().__init__(name, topology, config)
+        self.worker_idx = worker_idx
+        self.placement = placement
+        set_worker_tag(worker_idx)
+        self.senders: Dict[int, PeerSender] = {
+            idx: PeerSender(addr) for idx, addr in peers.items() if idx != worker_idx
+        }
+        self.ledger = DistLedger(
+            AckLedger(timeout_s=config.topology.message_timeout_s),
+            worker_idx,
+            self.senders,
+        )
+
+    def _local(self, component_id: str) -> bool:
+        return self.placement.get(component_id, 0) == self.worker_idx
+
+    def _make_executors(self) -> None:
+        tcfg = self.config.topology
+        for spec in self.topology.specs.values():
+            group = TargetGroup(spec.component_id)
+            self.groups[spec.component_id] = group
+            if self._local(spec.component_id):
+                if spec.is_spout:
+                    self.spout_execs[spec.component_id] = [
+                        SpoutExecutor(
+                            self, spec.component_id, i, clone_component(spec.obj),
+                            tcfg.max_spout_pending,
+                        )
+                        for i in range(spec.parallelism)
+                    ]
+                else:
+                    execs = [
+                        BoltExecutor(
+                            self, spec.component_id, i, clone_component(spec.obj),
+                            tcfg.inbox_capacity, tcfg.tick_interval_s,
+                        )
+                        for i in range(spec.parallelism)
+                    ]
+                    self.bolt_execs[spec.component_id] = execs
+                    group.inboxes = [e.inbox for e in execs]
+            elif not spec.is_spout:
+                # Remote component: proxy inboxes so groupings see the full
+                # task set and routing stays identical to single-host.
+                sender = self.senders[self.placement[spec.component_id]]
+                group.inboxes = [
+                    RemoteInbox(sender, spec.component_id, i)
+                    for i in range(spec.parallelism)
+                ]
+        for spec in self.topology.specs.values():
+            for sub in spec.inputs:
+                self.router.add(
+                    sub.source, sub.stream, sub.grouping,
+                    self.groups[spec.component_id],
+                )
+
+    async def start_bolts(self) -> None:
+        self._make_executors()
+        for s in self.senders.values():
+            s.start()
+        for execs in self.bolt_execs.values():
+            for e in execs:
+                e.start()
+        self._sweeper = asyncio.create_task(self._sweep_loop())
+
+    async def start_spouts(self) -> None:
+        for execs in self.spout_execs.values():
+            for e in execs:
+                e.start()
+
+    async def start(self) -> None:  # single-phase convenience (tests)
+        await self.start_bolts()
+        await self.start_spouts()
+
+    async def kill(self, wait_secs: float = 0.0) -> None:
+        await super().kill(wait_secs)
+        for s in self.senders.values():
+            await s.stop()
+
+    # ---- inbound (called from gRPC threads) ----------------------------------
+
+    def deliver_threadsafe(self, payload: bytes, loop: asyncio.AbstractEventLoop) -> None:
+        deliveries = transport.decode_deliveries(payload)
+
+        async def enqueue():
+            for component, task, t in deliveries:
+                group = self.groups.get(component)
+                if group is None or task >= len(group.inboxes):
+                    log.warning("delivery for unknown %s[%d] dropped", component, task)
+                    continue
+                await group.inboxes[task].put(t)
+
+        # Block the RPC until enqueued: cross-host backpressure.
+        asyncio.run_coroutine_threadsafe(enqueue(), loop).result(timeout=60)
+
+    def acks_threadsafe(self, payload: bytes, loop: asyncio.AbstractEventLoop) -> None:
+        ops = transport.decode_acks(payload)
+
+        def apply():
+            for op, root, edge in ops:
+                if op == "xor":
+                    self.ledger.xor(root, edge)
+                else:
+                    self.ledger.fail_root(root)
+
+        # Ledger on_done callbacks touch spout executor state -> loop thread.
+        loop.call_soon_threadsafe(apply)
+
+
+# ---- the worker process ------------------------------------------------------
+
+_BUILDERS = {
+    "standard": "storm_tpu.main:build_standard_topology",
+    "multi": "storm_tpu.main:build_multi_model_topology",
+}
+
+
+def _resolve_builder(name: str):
+    import importlib
+
+    path = _BUILDERS.get(name, name)
+    mod, _, fn = path.partition(":")
+    return getattr(importlib.import_module(mod), fn)
+
+
+class WorkerServer:
+    """One worker process: gRPC server + asyncio loop + one DistRuntime."""
+
+    def __init__(self, port: int, index: int) -> None:
+        self.index = index
+        self.loop = asyncio.new_event_loop()
+        self.rt: Optional[DistRuntime] = None
+        self._broker = None
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16),
+            options=transport._OPTS,
+        )
+        self._server.add_generic_rpc_handlers(
+            (DistHandler(self._on_deliver, self._on_ack, self._on_control),)
+        )
+        self.port = self._server.add_insecure_port(f"[::]:{port}")
+        self._stop = threading.Event()
+
+    # ---- RPC callbacks (gRPC threads) ----------------------------------------
+
+    def _on_deliver(self, request: bytes, context) -> bytes:
+        rt = self.rt  # snapshot: a concurrent 'kill' may null the attribute
+        if rt is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no topology")
+        rt.deliver_threadsafe(request, self.loop)
+        return b"{}"
+
+    def _on_ack(self, request: bytes, context) -> bytes:
+        rt = self.rt
+        if rt is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no topology")
+        rt.acks_threadsafe(request, self.loop)
+        return b"{}"
+
+    def _on_control(self, request: bytes, context) -> bytes:
+        try:
+            req = json.loads(request)
+            out = self._control(req) or {}
+            return json.dumps(out, default=str).encode("utf-8")
+        except Exception as e:
+            log.exception("control failed")
+            return json.dumps({"error": f"{type(e).__name__}: {e}"}).encode("utf-8")
+
+    def _run_on_loop(self, coro, timeout: float = 120.0):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+    def _control(self, req: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        cmd = req["cmd"]
+        if cmd == "ping":
+            return {"ok": True, "index": self.index}
+        if cmd == "submit":
+            cfg = Config.from_dict(req["config"])
+            from storm_tpu.main import _make_broker
+
+            self._broker = _make_broker(cfg)
+            builder = _resolve_builder(req.get("builder", "standard"))
+            topo = builder(cfg, self._broker)
+            self.rt = DistRuntime(
+                req["name"], topo, cfg, self.index,
+                {k: int(v) for k, v in req["placement"].items()},
+                {int(k): v for k, v in req["peers"].items()},
+            )
+            return {"ok": True}
+        assert self.rt is not None, "submit first"
+        if cmd == "start_bolts":
+            self._run_on_loop(self.rt.start_bolts())
+            return {"ok": True}
+        if cmd == "start_spouts":
+            self._run_on_loop(self.rt.start_spouts())
+            return {"ok": True}
+        if cmd == "metrics":
+            return {"metrics": self.rt.metrics.snapshot()}
+        if cmd == "health":
+            return {"health": self.rt.health()}
+        if cmd == "deactivate":
+            self._run_on_loop(self.rt.deactivate())
+            return {"ok": True}
+        if cmd == "drain":
+            ok = self._run_on_loop(
+                self.rt.drain(timeout_s=req.get("timeout_s", 30.0))
+            )
+            return {"ok": bool(ok)}
+        if cmd == "kill":
+            self._run_on_loop(self.rt.kill(req.get("wait_secs", 0.0)))
+            self.rt = None
+            return {"ok": True}
+        if cmd == "shutdown":
+            self._stop.set()
+            return {"ok": True}
+        raise ValueError(f"unknown control cmd {cmd!r}")
+
+    # ---- lifecycle -----------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        self._server.start()
+        print(json.dumps({"ready": True, "port": self.port, "index": self.index}),
+              flush=True)
+        threading.Thread(target=self._wait_stop, daemon=True).start()
+        try:
+            self.loop.run_forever()
+        finally:
+            self._server.stop(1).wait()
+
+    def _wait_stop(self) -> None:
+        self._stop.wait()
+        time.sleep(0.2)  # let the shutdown RPC complete
+        self.loop.call_soon_threadsafe(self.loop.stop)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="storm_tpu.dist.worker")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--index", type=int, required=True)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    WorkerServer(args.port, args.index).serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

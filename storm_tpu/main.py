@@ -134,22 +134,32 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
         desc = cfg.model.name
     cluster = AsyncLocalCluster()
     rt = await cluster.submit(name, cfg, topo)
-    scaler = None
+    scalers = []
     if autoscale_target_ms > 0:
         from storm_tpu.runtime.autoscale import Autoscaler, AutoscalePolicy
 
-        scaler = Autoscaler(
-            rt,
-            AutoscalePolicy(
-                component="inference-bolt",
-                latency_source="kafka-bolt",
-                high_ms=autoscale_target_ms,
-                low_ms=autoscale_target_ms / 4,
-            ),
-        ).start()
+        # One autoscaler per inference/sink pair: the standard topology has
+        # one; a multi-model topology has one per pipeline.
+        pairs = (
+            [(f"{p.name}-inference", f"{p.name}-sink") for p in cfg.pipelines]
+            if cfg.pipelines
+            else [("inference-bolt", "kafka-bolt")]
+        )
+        scalers = [
+            Autoscaler(
+                rt,
+                AutoscalePolicy(
+                    component=infer_id,
+                    latency_source=sink_id,
+                    high_ms=autoscale_target_ms,
+                    low_ms=autoscale_target_ms / 4,
+                ),
+            ).start()
+            for infer_id, sink_id in pairs
+        ]
     print(f"topology {name!r} running "
           f"(model={desc}, broker={cfg.broker.kind}"
-          f"{', autoscaling' if scaler else ''})", file=sys.stderr)
+          f"{', autoscaling' if scalers else ''})", file=sys.stderr)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -160,7 +170,7 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
     await stop.wait()
 
     print("draining...", file=sys.stderr)
-    if scaler is not None:
+    for scaler in scalers:
         await scaler.stop()
     await rt.deactivate()
     await rt.drain(timeout_s=30)

@@ -23,13 +23,32 @@ from typing import Any, FrozenSet, Optional, Sequence
 _rng = random.Random(int.from_bytes(os.urandom(16), "big"))
 _randbits = _rng.getrandbits
 
+# Multi-host routing (storm_tpu.dist): the top 8 bits of every id carry the
+# index of the worker process that generated it, so any worker receiving a
+# tuple can route acks for its root back to the ledger owner without a
+# lookup table. Single-process runtimes keep tag 0 and never consult it.
+_worker_tag = 0
+
+
+def set_worker_tag(index: int) -> None:
+    """Stamp ids from this process with a worker index (0..255)."""
+    global _worker_tag
+    if not 0 <= index < 256:
+        raise ValueError(f"worker index {index} out of range 0..255")
+    _worker_tag = index << 56
+
+
+def owner_of(ident: int) -> int:
+    """The worker index that generated (and owns the ledger entry for) an id."""
+    return ident >> 56
+
 
 def new_id() -> int:
-    """Random non-zero 64-bit id (zero is the acker's 'complete' value)."""
+    """Random non-zero worker-tagged 64-bit id (zero = acker 'complete')."""
     while True:
-        v = _randbits(64)
+        v = _randbits(56)
         if v:
-            return v
+            return _worker_tag | v
 
 
 class Values(list):
