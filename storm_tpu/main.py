@@ -200,6 +200,23 @@ def main(argv=None) -> int:
                            "equivalent of the reference's rebuild-with-more-"
                            "bolts scaling thesis (README.md:13-14)")
 
+    distp = sub.add_parser(
+        "dist-run",
+        help="run a topology across worker processes (gRPC tuple transport)")
+    distp.add_argument("name")
+    distp.add_argument("input_topic")
+    distp.add_argument("output_topic")
+    distp.add_argument("--config", help="TOML/JSON config file")
+    distp.add_argument("--set", action="append", default=[],
+                       metavar="section.key=value")
+    distp.add_argument("--workers", type=int, default=3,
+                       help="local worker processes to spawn")
+    distp.add_argument("--attach", action="append", default=[],
+                       metavar="host:port",
+                       help="attach to pre-started workers instead of "
+                            "spawning (multi-host)")
+    distp.add_argument("--duration", type=float, default=0.0)
+
     servep = sub.add_parser("serve", help="run the gRPC TPU inference worker")
     servep.add_argument("--config", help="TOML/JSON config file")
     servep.add_argument("--set", action="append", default=[])
@@ -226,6 +243,36 @@ def main(argv=None) -> int:
             )
         asyncio.run(_run_daemon(args.name, cfg, args.duration,
                                 args.autoscale_target_ms))
+        return 0
+
+    if args.cmd == "dist-run":
+        cfg = _load_config(args)
+        cfg.broker.input_topic = args.input_topic
+        cfg.broker.output_topic = args.output_topic
+        if cfg.broker.kind != "kafka":
+            print("dist-run needs broker.kind=kafka (workers are separate "
+                  "processes; a memory broker cannot be shared)", file=sys.stderr)
+            return 2
+        from storm_tpu.dist import DistCluster
+
+        builder = "multi" if cfg.pipelines else "standard"
+        with DistCluster(
+            n_workers=args.workers, addrs=args.attach or None
+        ) as cluster:
+            placement = cluster.submit(args.name, cfg, builder=builder)
+            print(f"topology {args.name!r} across {len(cluster.clients)} "
+                  f"workers: {placement}", file=sys.stderr)
+            try:
+                if args.duration > 0:
+                    time.sleep(args.duration)
+                else:
+                    signal.sigwait({signal.SIGINT, signal.SIGTERM})
+            except KeyboardInterrupt:
+                pass
+            print("draining...", file=sys.stderr)
+            cluster.drain(timeout_s=30)
+            print(json.dumps(cluster.metrics(), default=str), file=sys.stderr)
+            cluster.kill()
         return 0
 
     if args.cmd == "serve":
