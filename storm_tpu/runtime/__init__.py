@@ -2,8 +2,11 @@ from storm_tpu.runtime.tuples import Tuple, TickTuple, Values
 from storm_tpu.runtime.topology import TopologyBuilder, Topology
 from storm_tpu.runtime.base import Spout, Bolt, OutputCollector, TopologyContext
 from storm_tpu.runtime.cluster import LocalCluster
+from storm_tpu.runtime.window import TumblingWindowBolt, WindowedBolt
 
 __all__ = [
+    "WindowedBolt",
+    "TumblingWindowBolt",
     "Tuple",
     "TickTuple",
     "Values",
