@@ -14,8 +14,10 @@ the same era as the reference's Kafka 0.11 (pom.xml:55-78):
 - OffsetCommit v2 (api 8) / OffsetFetch v1 (api 9) — "simple consumer"
   commits (generation -1, empty member), no group-membership protocol
 
-Compression is not used (attributes=0); compressed fetches from other
-producers are rejected with a clear error rather than silently dropped.
+Produced messages are uncompressed (attributes=0); fetched gzip wrapper
+messages from other producers are decompressed (relative inner offsets per
+KIP-31); snappy/lz4 are rejected with a clear error rather than silently
+dropped.
 
 :class:`KafkaWireBroker` adapts this client to the same surface as
 :class:`storm_tpu.connectors.memory.MemoryBroker`, so ``BrokerSpout`` /
@@ -135,8 +137,10 @@ def encode_message_set(
 
 
 def decode_message_set(topic: str, partition: int, data: bytes) -> List[Record]:
-    """MessageSet (v0/v1 messages) -> Records. RecordBatch (magic 2) and
-    compressed sets are rejected explicitly."""
+    """MessageSet (v0/v1 messages) -> Records. gzip wrapper messages are
+    decompressed (external producers commonly enable it); snappy/lz4 are
+    rejected (no codec deps in this environment), as is RecordBatch
+    (magic 2)."""
     records: List[Record] = []
     r = Reader(data)
     while r.remaining >= 12:
@@ -153,12 +157,31 @@ def decode_message_set(topic: str, partition: int, data: bytes) -> List[Record]:
                 "Fetch version the broker down-converts for"
             )
         attrs = body.i8()
-        if attrs & 0x07:
-            raise KafkaProtocolError("compressed message sets not supported")
+        codec = attrs & 0x07
         ts = body.i64() / 1e3 if magic == 1 else time.time()
         key = body.bytes_()
         value = body.bytes_() or b""
-        records.append(Record(topic, partition, offset, key, value, ts))
+        if codec == 0:
+            records.append(Record(topic, partition, offset, key, value, ts))
+            continue
+        if codec != 1:
+            raise KafkaProtocolError(
+                f"unsupported compression codec {codec} (only gzip=1)"
+            )
+        # gzip wrapper: the value is an inner message set. For magic 1
+        # (KIP-31) inner offsets are 0-based relative and the wrapper carries
+        # the offset of the LAST inner message; for magic 0 they're absolute.
+        import gzip as _gzip
+
+        inner = decode_message_set(topic, partition, _gzip.decompress(value))
+        if magic == 1 and inner:
+            base = offset - (len(inner) - 1)
+            inner = [
+                Record(rec.topic, rec.partition, base + i, rec.key, rec.value,
+                       rec.timestamp)
+                for i, rec in enumerate(inner)
+            ]
+        records.extend(inner)
     return records
 
 

@@ -157,3 +157,59 @@ def test_wire_broker_fetch_buffers_remainder(stub):
         assert [r.offset for r in seek][0] == 12
     finally:
         b.close()
+
+
+def test_gzip_wrapper_message_decode():
+    """gzip-compressed wrapper (magic 1, KIP-31 relative inner offsets) is
+    transparently decompressed; snappy/lz4 still reject."""
+    import gzip
+    import struct
+    import zlib
+
+    from storm_tpu.connectors.kafka_protocol import (
+        Writer,
+        decode_message_set,
+        encode_message_set,
+    )
+
+    inner = encode_message_set(
+        [(None, b"v0"), (None, b"v1"), (b"k", b"v2")],
+        ts_ms=1_700_000_000_000,
+        offsets=[0, 1, 2],  # relative per KIP-31
+    )
+    wrapped = gzip.compress(inner)
+    msg = Writer()
+    msg.i8(1)  # magic
+    msg.i8(1)  # attributes: gzip
+    msg.i64(1_700_000_000_000)
+    msg.bytes_(None)
+    msg.bytes_(wrapped)
+    crc = zlib.crc32(bytes(msg.buf)) & 0xFFFFFFFF
+    full = Writer()
+    full.i64(107)  # wrapper offset = offset of LAST inner message
+    full.i32(4 + len(msg.buf))
+    full.buf += struct.pack(">I", crc)
+    full.raw(bytes(msg.buf))
+
+    recs = decode_message_set("t", 0, bytes(full.buf))
+    assert [r.value for r in recs] == [b"v0", b"v1", b"v2"]
+    assert [r.offset for r in recs] == [105, 106, 107]
+    assert recs[2].key == b"k"
+
+    # unsupported codec (snappy=2) still raises
+    from storm_tpu.connectors.kafka_protocol import KafkaProtocolError
+
+    msg2 = Writer()
+    msg2.i8(1)
+    msg2.i8(2)  # snappy
+    msg2.i64(0)
+    msg2.bytes_(None)
+    msg2.bytes_(b"xx")
+    crc2 = zlib.crc32(bytes(msg2.buf)) & 0xFFFFFFFF
+    full2 = Writer()
+    full2.i64(0)
+    full2.i32(4 + len(msg2.buf))
+    full2.buf += struct.pack(">I", crc2)
+    full2.raw(bytes(msg2.buf))
+    with pytest.raises(KafkaProtocolError, match="codec"):
+        decode_message_set("t", 0, bytes(full2.buf))
