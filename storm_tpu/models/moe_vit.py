@@ -1,0 +1,104 @@
+"""MoE-ViT: Vision Transformer with mixture-of-experts MLP blocks.
+
+No MoE exists anywhere in the reference (SURVEY.md §2.4 EP row); this makes
+the expert-parallel layer (:mod:`storm_tpu.parallel.moe`) a servable model
+family: alternating dense/MoE encoder blocks (the Switch-Transformer
+placement), top-1 routing with capacity bounds, experts shardable over an
+``expert`` mesh axis for training (``__graft_entry__``'s ep dryrun) and
+replicated for single-chip serving. At inference the router still runs —
+capacity-dropped tokens pass through the residual — and the aux loss is
+discarded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from storm_tpu.models.registry import ModelDef, register
+from storm_tpu.ops import layers as L
+from storm_tpu.parallel.moe import moe_block, moe_block_init
+from storm_tpu.models.vit import _block, _block_init
+
+
+def build_moe_vit(
+    name: str,
+    num_classes: int,
+    input_shape: tuple,
+    patch: int,
+    dim: int,
+    depth: int,
+    num_heads: int,
+    mlp_dim: int,
+    n_experts: int,
+    capacity_factor: float = 1.25,
+) -> ModelDef:
+    h, w, c = input_shape
+    if h % patch or w % patch:
+        raise ValueError(f"input {h}x{w} not divisible by patch size {patch}")
+    n_patches = (h // patch) * (w // patch)
+    seq = n_patches + 1
+
+    def init(rng):
+        ks = jax.random.split(rng, depth + 4)
+        blocks = []
+        for i in range(depth):
+            if i % 2 == 1:  # odd blocks are MoE (Switch placement)
+                blocks.append(
+                    moe_block_init(ks[2 + i], dim, mlp_dim, num_heads, n_experts)
+                )
+            else:
+                blocks.append(_block_init(ks[2 + i], dim, mlp_dim, num_heads))
+        params = {
+            "embed": L.conv_init(ks[0], patch, patch, c, dim),
+            "cls": jnp.zeros((1, 1, dim), jnp.float32),
+            "pos": L.trunc_normal(ks[1], (1, seq, dim)),
+            "blocks": blocks,
+            "ln": L.layernorm_init(dim),
+            "head": L.dense_init(ks[depth + 2], dim, num_classes),
+        }
+        return params, {}
+
+    def apply(params, state, x, train: bool = False):
+        b = x.shape[0]
+        tok = L.conv2d(params["embed"], x, stride=patch, padding="VALID")
+        tok = tok.reshape(b, n_patches, dim)
+        cls = jnp.broadcast_to(params["cls"].astype(tok.dtype), (b, 1, dim))
+        tok = jnp.concatenate([cls, tok], axis=1) + params["pos"].astype(tok.dtype)
+        aux_total = 0.0
+        for p_blk in params["blocks"]:
+            if "moe" in p_blk:
+                tok, aux = moe_block(p_blk, tok, num_heads,
+                                     capacity_factor=capacity_factor)
+                aux_total = aux_total + aux
+            else:
+                tok = _block(p_blk, tok, num_heads)
+        tok = L.layernorm(params["ln"], tok)
+        logits = L.dense(params["head"], tok[:, 0])
+        # Training surface carries the load-balancing aux loss in state;
+        # inference discards it (state is returned unchanged when not train).
+        if train:
+            return logits, {**state, "moe_aux_loss": aux_total}
+        return logits, state
+
+    return ModelDef(name, input_shape, num_classes, init, apply)
+
+
+@register("moe_vit_tiny")
+def build_moe_vit_tiny(num_classes: int = 10,
+                       input_shape: tuple = (32, 32, 3)) -> ModelDef:
+    """Small MoE-ViT for tests: 4 blocks (2 dense + 2 MoE x 4 experts)."""
+    return build_moe_vit(
+        "moe_vit_tiny", num_classes, input_shape, patch=8, dim=64, depth=4,
+        num_heads=4, mlp_dim=128, n_experts=4,
+    )
+
+
+@register("moe_vit_b16")
+def build_moe_vit_b16(num_classes: int = 1000,
+                      input_shape: tuple = (224, 224, 3)) -> ModelDef:
+    """ViT-B/16 with 8-expert MoE MLPs in every other block."""
+    return build_moe_vit(
+        "moe_vit_b16", num_classes, input_shape, patch=16, dim=768, depth=12,
+        num_heads=12, mlp_dim=3072, n_experts=8,
+    )
