@@ -149,6 +149,29 @@ class DistCluster:
         return {i: c.control("health")["health"]
                 for i, c in enumerate(self.clients)}
 
+    def rebalance(self, component: str, parallelism: int) -> None:
+        """Live parallelism change across the cluster (the reference's
+        scale-out knob, README.md:13-14, but at runtime and multi-host).
+
+        The hosting worker changes its executor count; every other worker
+        resizes its proxy-inbox view so groupings route over the new task
+        set. Ordering prevents routing to tasks that don't exist: grow the
+        host before peers widen; shrink peers before the host removes."""
+        w = self._placement.get(component)
+        if w is None:
+            raise KeyError(component)
+        host = self.clients[w]
+        current = host.control("parallelism", component=component)["parallelism"]
+        others = [c for i, c in enumerate(self.clients) if i != w]
+        if parallelism >= current:
+            host.control("rebalance", component=component, parallelism=parallelism)
+            for c in others:
+                c.control("rebalance", component=component, parallelism=parallelism)
+        else:
+            for c in others:
+                c.control("rebalance", component=component, parallelism=parallelism)
+            host.control("rebalance", component=component, parallelism=parallelism)
+
     # ---- teardown ------------------------------------------------------------
 
     def drain(self, timeout_s: float = 30.0) -> bool:

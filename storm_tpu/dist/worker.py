@@ -270,6 +270,21 @@ class DistRuntime(TopologyRuntime):
                     self.groups[spec.component_id],
                 )
 
+    async def resize_remote_group(self, component: str, parallelism: int) -> None:
+        """Resize this worker's proxy-inbox view of a component hosted
+        elsewhere, so groupings route over the component's new task count."""
+        group = self.groups[component]
+        sender = self.senders[self.placement[component]]
+        cur = len(group.inboxes)
+        if parallelism > cur:
+            group.inboxes.extend(
+                RemoteInbox(sender, component, i) for i in range(cur, parallelism)
+            )
+        else:
+            del group.inboxes[parallelism:]
+        self.router.reprepare(component)
+        self.topology.specs[component].parallelism = parallelism
+
     async def start_bolts(self) -> None:
         self._make_executors()
         for s in self.senders.values():
@@ -409,6 +424,17 @@ class WorkerServer:
         if cmd == "start_spouts":
             self._run_on_loop(self.rt.start_spouts())
             return {"ok": True}
+        if cmd == "parallelism":
+            return {"parallelism": self.rt.parallelism_of(req["component"])}
+        if cmd == "rebalance":
+            component = req["component"]
+            new = int(req["parallelism"])
+            prev = self.rt.parallelism_of(component)
+            if self.rt._local(component):
+                self._run_on_loop(self.rt.rebalance(component, new))
+            else:
+                self._run_on_loop(self.rt.resize_remote_group(component, new))
+            return {"ok": True, "previous": prev}
         if cmd == "metrics":
             return {"metrics": self.rt.metrics.snapshot()}
         if cmd == "health":
@@ -454,6 +480,17 @@ def main(argv=None) -> int:
     ap.add_argument("--index", type=int, required=True)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    # Some PJRT plugins (e.g. the tunneled-TPU one in this dev environment)
+    # register regardless of JAX_PLATFORMS; STORM_TPU_PLATFORM pins the
+    # backend hard via jax.config, which the plugin cannot override. Tests
+    # set it to "cpu" so worker processes never contend for the one TPU.
+    import os
+
+    plat = os.environ.get("STORM_TPU_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     WorkerServer(args.port, args.index).serve_forever()
     return 0
 

@@ -88,7 +88,7 @@ def test_dist_three_workers_end_to_end():
         }
         n_msgs = 12
         rng = np.random.RandomState(0)
-        with DistCluster(3, env={"JAX_PLATFORMS": "cpu"}) as cluster:
+        with DistCluster(3, env={"JAX_PLATFORMS": "cpu", "STORM_TPU_PLATFORM": "cpu"}) as cluster:
             used = cluster.submit("dist-e2e", cfg, placement)
             assert used == placement
 
@@ -125,6 +125,29 @@ def test_dist_three_workers_end_to_end():
             assert snap["inference-bolt"]["dead_lettered"] >= 1
             health = cluster.health()
             assert len(health) == 3
+
+            # Live cross-host rebalance: scale inference 2 -> 3, then push
+            # more traffic through the resized routing.
+            cluster.rebalance("inference-bolt", 3)
+            before = stub.topic_size("dist-out")
+            for i in range(6):
+                x = rng.rand(1, 28, 28, 1).astype(np.float32)
+                producer.produce("dist-in", json.dumps({"instances": x.tolist()}))
+            deadline = time.time() + 60
+            while time.time() < deadline and stub.topic_size("dist-out") < before + 6:
+                time.sleep(0.1)
+            assert stub.topic_size("dist-out") >= before + 6
+
+            # And back down to 1: peers narrow before the host shrinks.
+            cluster.rebalance("inference-bolt", 1)
+            before = stub.topic_size("dist-out")
+            for i in range(4):
+                x = rng.rand(1, 28, 28, 1).astype(np.float32)
+                producer.produce("dist-in", json.dumps({"instances": x.tolist()}))
+            deadline = time.time() + 60
+            while time.time() < deadline and stub.topic_size("dist-out") < before + 4:
+                time.sleep(0.1)
+            assert stub.topic_size("dist-out") >= before + 4
             cluster.kill()
     finally:
         stub.close()
@@ -151,7 +174,7 @@ def test_dist_auto_placement_single_worker():
         cfg.topology.inference_parallelism = 1
         cfg.topology.sink_parallelism = 1
 
-        with DistCluster(1, env={"JAX_PLATFORMS": "cpu"}) as cluster:
+        with DistCluster(1, env={"JAX_PLATFORMS": "cpu", "STORM_TPU_PLATFORM": "cpu"}) as cluster:
             placement = cluster.submit("dist-one", cfg)
             assert set(placement.values()) == {0}
 
