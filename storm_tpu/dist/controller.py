@@ -157,20 +157,19 @@ class DistCluster:
         resizes its proxy-inbox view so groupings route over the new task
         set. Ordering prevents routing to tasks that don't exist: grow the
         host before peers widen; shrink peers before the host removes."""
+        if parallelism < 1:
+            # Validate before touching ANY worker: peers' proxy views are
+            # resized with no rollback, so a bad value must never reach them.
+            raise ValueError("parallelism must be >= 1")
         w = self._placement.get(component)
         if w is None:
             raise KeyError(component)
         host = self.clients[w]
         current = host.control("parallelism", component=component)["parallelism"]
         others = [c for i, c in enumerate(self.clients) if i != w]
-        if parallelism >= current:
-            host.control("rebalance", component=component, parallelism=parallelism)
-            for c in others:
-                c.control("rebalance", component=component, parallelism=parallelism)
-        else:
-            for c in others:
-                c.control("rebalance", component=component, parallelism=parallelism)
-            host.control("rebalance", component=component, parallelism=parallelism)
+        targets = [host, *others] if parallelism >= current else [*others, host]
+        for c in targets:
+            c.control("rebalance", component=component, parallelism=parallelism)
 
     # ---- teardown ------------------------------------------------------------
 
@@ -181,6 +180,11 @@ class DistCluster:
         for c in self.clients:
             ok = c.control("drain", timeout_s=timeout_s).get("ok", False) and ok
         return ok
+
+    def activate(self) -> None:
+        """Resume spouts after a deactivate/drain (Storm's 'activate')."""
+        for c in self.clients:
+            c.control("activate")
 
     def kill(self, wait_secs: float = 0.0) -> None:
         for c in self.clients:

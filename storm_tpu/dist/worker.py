@@ -273,6 +273,12 @@ class DistRuntime(TopologyRuntime):
     async def resize_remote_group(self, component: str, parallelism: int) -> None:
         """Resize this worker's proxy-inbox view of a component hosted
         elsewhere, so groupings route over the component's new task count."""
+        spec = self.topology.specs[component]
+        if spec.is_spout:
+            # Spouts are never delivery targets: their proxy view must stay
+            # empty or deliver_threadsafe's unknown-target guard is defeated.
+            spec.parallelism = parallelism
+            return
         group = self.groups[component]
         sender = self.senders[self.placement[component]]
         cur = len(group.inboxes)
@@ -441,6 +447,9 @@ class WorkerServer:
             return {"health": self.rt.health()}
         if cmd == "deactivate":
             self._run_on_loop(self.rt.deactivate())
+            return {"ok": True}
+        if cmd == "activate":
+            self._run_on_loop(self.rt.activate())
             return {"ok": True}
         if cmd == "drain":
             ok = self._run_on_loop(

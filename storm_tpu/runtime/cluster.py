@@ -241,6 +241,14 @@ class TopologyRuntime:
                 e._active = False
                 await e.spout.deactivate()
 
+    async def activate(self) -> None:
+        """Resume spouts after a deactivate (Storm's 'activate' — the other
+        half of the pair; the executor loop polls ``_active``)."""
+        for execs in self.spout_execs.values():
+            for e in execs:
+                e._active = True
+                await e.spout.activate()
+
     async def drain(self, timeout_s: float = 30.0) -> bool:
         """Wait for all in-flight tuple trees and inboxes to empty."""
         deadline = time.monotonic() + timeout_s
@@ -304,6 +312,10 @@ class TopologyRuntime:
                 await e.stop(drain=True)
         elif component_id in self.spout_execs:
             execs = self.spout_execs[component_id]
+            # New tasks inherit the component's activation state: a grow
+            # during a deactivate/drain must not start an emitting spout
+            # (same invariant _supervise preserves on restart).
+            active = all(e._active for e in execs) if execs else True
             while len(execs) < parallelism:
                 e = SpoutExecutor(
                     self,
@@ -312,6 +324,7 @@ class TopologyRuntime:
                     clone_component(proto),
                     tcfg.max_spout_pending,
                 )
+                e._active = active
                 execs.append(e)
                 e.start()
             while len(execs) > parallelism:
@@ -375,6 +388,9 @@ class LocalCluster:
 
     def deactivate(self, name: str) -> None:
         self._run(self._cluster.runtime(name).deactivate())
+
+    def activate(self, name: str) -> None:
+        self._run(self._cluster.runtime(name).activate())
 
     def drain(self, name: str, timeout_s: float = 30.0) -> bool:
         return self._run(self._cluster.runtime(name).drain(timeout_s))

@@ -125,6 +125,8 @@ def test_dist_three_workers_end_to_end():
             assert snap["inference-bolt"]["dead_lettered"] >= 1
             health = cluster.health()
             assert len(health) == 3
+            # drain() deactivated the spouts; resume them before the next phase
+            cluster.activate()
 
             # Live cross-host rebalance: scale inference 2 -> 3, then push
             # more traffic through the resized routing.
@@ -137,6 +139,11 @@ def test_dist_three_workers_end_to_end():
             while time.time() < deadline and stub.topic_size("dist-out") < before + 6:
                 time.sleep(0.1)
             assert stub.topic_size("dist-out") >= before + 6
+
+            # Bad parallelism must be rejected before ANY worker's proxy
+            # view is touched (no rollback exists on the peers).
+            with pytest.raises(ValueError):
+                cluster.rebalance("inference-bolt", 0)
 
             # And back down to 1: peers narrow before the host shrinks.
             cluster.rebalance("inference-bolt", 1)

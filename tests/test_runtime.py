@@ -309,6 +309,43 @@ def test_rebalance_live(run):
     assert len(CaptureBolt.seen) == 30
 
 
+def test_deactivate_activate_pause_resume(run):
+    """deactivate stops the spout pulling; activate resumes it; a spout
+    grown while deactivated must come up paused (not emitting)."""
+    CaptureBolt.seen = None
+
+    async def go():
+        cluster = AsyncLocalCluster()
+        b = TopologyBuilder()
+        n_items = 20000
+        spout = ListSpout([f"m{i}" for i in range(n_items)])
+        b.set_spout("s", spout, 1)
+        b.set_bolt("c", CaptureBolt(), 1).shuffle_grouping("s")
+        rt = await cluster.submit("t", Config(), b.build())
+        await rt.deactivate()
+        assert await rt.drain(timeout_s=30.0)
+        spout = rt.spout_execs["s"][0].spout  # the live (cloned) instance
+        paused_at = len(spout.acked)
+        # while deactivated: grow the spout; the new task inherits paused
+        await rt.rebalance("s", 2)
+        assert all(not e._active for e in rt.spout_execs["s"])
+        await asyncio.sleep(0.2)
+        assert len(spout.acked) == paused_at  # nothing moved while paused
+        await rt.activate()
+        assert all(e._active for e in rt.spout_execs["s"])
+        deadline = asyncio.get_event_loop().time() + 10
+        while (asyncio.get_event_loop().time() < deadline
+               and len(spout.acked) <= paused_at):
+            await asyncio.sleep(0.01)
+        resumed = len(spout.acked) > paused_at
+        await cluster.shutdown()
+        return paused_at, resumed
+
+    paused_at, resumed = run(go())
+    assert paused_at < 20000  # the pause bit mid-stream
+    assert resumed
+
+
 def test_sync_localcluster_facade():
     CaptureBolt.seen = None
     with LocalCluster() as cluster:
