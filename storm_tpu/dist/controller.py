@@ -20,13 +20,17 @@ omitted (spouts pinned to worker 0 so ledgers sit with their spouts).
 from __future__ import annotations
 
 import json
+import logging
 import subprocess
 import sys
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from storm_tpu.config import Config
 from storm_tpu.dist.transport import WorkerClient
+
+log = logging.getLogger("storm_tpu.dist.controller")
 
 
 class DistCluster:
@@ -38,44 +42,56 @@ class DistCluster:
     ) -> None:
         """Spawn ``n_workers`` local worker processes, or attach to
         ``addrs`` (["host:port", ...]) if given."""
-        self.procs: List[subprocess.Popen] = []
+        self.procs: List[Optional[subprocess.Popen]] = []
         self.clients: List[WorkerClient] = []
         self._stderr_files: List = []
+        self._env = env
+        self._lock = threading.Lock()
+        self._monitor: Optional[threading.Thread] = None
+        self._monitor_stop = threading.Event()
+        self._recipe: Optional[dict] = None
+        self._rebalances: Dict[str, int] = {}
+        self._activated = True
+        self._closing = False
         if addrs:
             for addr in addrs:
                 self.clients.append(WorkerClient(addr))
         else:
-            import os
-            import tempfile
-
             for i in range(n_workers):
-                # stderr to a tempfile (not PIPE: an unread pipe would block
-                # a chatty worker; not DEVNULL: startup crashes must be
-                # diagnosable).
-                errf = tempfile.TemporaryFile()
-                self._stderr_files.append(errf)
-                proc = subprocess.Popen(
-                    [sys.executable, "-m", "storm_tpu.dist.worker",
-                     "--port", "0", "--index", str(i)],
-                    stdout=subprocess.PIPE,
-                    stderr=errf,
-                    env={**os.environ, **(env or {})},
-                )
+                proc, client = self._spawn_worker(i)
                 self.procs.append(proc)
-                # Worker prints one JSON ready-line with its bound port.
-                line = proc.stdout.readline().decode()
-                if not line.strip():
-                    errf.seek(0)
-                    tail = errf.read()[-4000:].decode("utf-8", "replace")
-                    raise RuntimeError(
-                        f"worker {i} died during startup; stderr tail:\n{tail}"
-                    )
-                info = json.loads(line)
-                self.clients.append(WorkerClient(f"127.0.0.1:{info['port']}"))
+                self.clients.append(client)
         for c in self.clients:
             c.wait_ready()
         self.peers = {i: c.target for i, c in enumerate(self.clients)}
         self._placement: Dict[str, int] = {}
+
+    def _spawn_worker(self, index: int):
+        import os
+        import tempfile
+
+        # stderr to a tempfile (not PIPE: an unread pipe would block
+        # a chatty worker; not DEVNULL: startup crashes must be
+        # diagnosable).
+        errf = tempfile.TemporaryFile()
+        self._stderr_files.append(errf)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "storm_tpu.dist.worker",
+             "--port", "0", "--index", str(index)],
+            stdout=subprocess.PIPE,
+            stderr=errf,
+            env={**os.environ, **(self._env or {})},
+        )
+        # Worker prints one JSON ready-line with its bound port.
+        line = proc.stdout.readline().decode()
+        if not line.strip():
+            errf.seek(0)
+            tail = errf.read()[-4000:].decode("utf-8", "replace")
+            raise RuntimeError(
+                f"worker {index} died during startup; stderr tail:\n{tail}"
+            )
+        info = json.loads(line)
+        return proc, WorkerClient(f"127.0.0.1:{info['port']}")
 
     # ---- topology lifecycle --------------------------------------------------
 
@@ -94,6 +110,9 @@ class DistCluster:
         if bad:
             raise ValueError(f"placement onto unknown workers: {bad}")
         self._placement = placement
+        self._recipe = {
+            "name": name, "config": cfg.to_dict(), "builder": builder,
+        }
         for c in self.clients:
             c.control(
                 "submit",
@@ -170,10 +189,148 @@ class DistCluster:
         targets = [host, *others] if parallelism >= current else [*others, host]
         for c in targets:
             c.control("rebalance", component=component, parallelism=parallelism)
+        # Recorded so a recovered worker rebuilds at the LIVE parallelism,
+        # not the submit-time one (else survivors route to tasks the
+        # replacement doesn't have).
+        self._rebalances[component] = parallelism
+
+    # ---- failure detection + elastic recovery (SURVEY.md §5.3) ---------------
+
+    def start_monitor(
+        self,
+        interval_s: float = 1.0,
+        misses: int = 3,
+        on_dead: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        """Heartbeat monitor: ping every worker each ``interval_s``; after
+        ``misses`` consecutive failures declare it dead and recover — the
+        Storm-supervisor/Nimbus role the reference delegates wholesale
+        (SURVEY.md §5.3: "supervisors restart dead workers"). Default
+        recovery is :meth:`recover_worker`; pass ``on_dead`` to override
+        (e.g. multi-host deployments that respawn remotely)."""
+        if self._monitor is not None:
+            raise RuntimeError("monitor already running")
+        self._monitor_stop.clear()
+        fails = [0] * len(self.clients)
+
+        def loop() -> None:
+            while not self._monitor_stop.wait(interval_s):
+                for i in range(len(self.clients)):
+                    with self._lock:
+                        client = self.clients[i]
+                    try:
+                        client.control("ping", timeout=max(1.0, interval_s))
+                        fails[i] = 0
+                    except Exception:
+                        fails[i] += 1
+                    if fails[i] < misses:
+                        continue
+                    log.error("worker %d missed %d heartbeats; recovering",
+                              i, fails[i])
+                    fails[i] = 0
+                    try:
+                        (on_dead or self.recover_worker)(i)
+                    except Exception:
+                        log.exception("recovery of worker %d failed "
+                                      "(will retry on next detection)", i)
+
+        self._monitor = threading.Thread(
+            target=loop, name="dist-heartbeat", daemon=True
+        )
+        self._monitor.start()
+
+    def stop_monitor(self) -> None:
+        if self._monitor is None:
+            return
+        self._monitor_stop.set()
+        # A recovery in flight (spawn + wait_ready + submit) can take tens
+        # of seconds; joining short and proceeding would let shutdown race
+        # it and orphan the replacement process.
+        self._monitor.join(timeout=120)
+        self._monitor = None
+
+    def recover_worker(self, idx: int) -> None:
+        """Replace a dead worker: respawn the process at the same index,
+        rewire surviving peers to the new address, and re-ship the topology
+        recipe so the replacement rebuilds and restarts its components.
+
+        Tuples that were in flight on the dead worker are gone; the spout
+        ledger times their trees out and replays them through the
+        replacement (at-least-once — exactly Storm's story when a
+        supervisor restarts a worker). Only valid for controller-spawned
+        workers: attached remote workers must be respawned by their own
+        host, then re-wired via ``on_dead``."""
+        with self._lock:
+            if self._closing:
+                return
+            if not self.procs:
+                raise RuntimeError(
+                    "recover_worker only applies to spawned workers"
+                )
+            old_proc = self.procs[idx]
+            if old_proc is not None:
+                old_proc.kill()
+                old_proc.wait(timeout=10)
+            try:
+                self.clients[idx].close()
+            except Exception:
+                pass
+            proc, client = self._spawn_worker(idx)
+            client.wait_ready()
+            self.procs[idx] = proc
+            self.clients[idx] = client
+            self.peers[idx] = client.target
+            # Surviving peers aim their senders at the replacement. A peer
+            # left pointing at the dead address would replay its tuples
+            # forever, so retry; if a peer stays unreachable, kill the
+            # replacement and raise — its dead heartbeat makes the monitor
+            # re-run the whole recovery rather than half-wire the cluster.
+            for i, c in enumerate(self.clients):
+                if i == idx:
+                    continue
+                for attempt in range(3):
+                    try:
+                        c.control("update_peer", idx=idx, addr=client.target)
+                        break
+                    except Exception as e:
+                        if attempt == 2:
+                            proc.kill()
+                            raise RuntimeError(
+                                f"peer {i} rewire failed; recovery aborted"
+                            ) from e
+                        time.sleep(0.5 * 2**attempt)
+            # Replacement rebuilds its share of the topology, at the LIVE
+            # lifecycle state: current parallelisms, and spouts paused if
+            # the cluster is deactivated/draining.
+            if self._recipe is not None:
+                client.control(
+                    "submit",
+                    name=self._recipe["name"],
+                    config=self._recipe["config"],
+                    placement=self._placement,
+                    peers=self.peers,
+                    builder=self._recipe["builder"],
+                )
+                client.control("start_bolts")
+                if not self._activated:
+                    # Executors exist after start_bolts; pausing before
+                    # start_spouts means they start with _active=False and
+                    # never emit.
+                    client.control("deactivate")
+                client.control("start_spouts")
+                # Re-apply live rebalances AFTER start (rebalance starts the
+                # executors it adds; applying pre-start would double-start
+                # them). Until these land, deliveries to not-yet-grown tasks
+                # drop and replay — at-least-once covers the window.
+                for component, par in self._rebalances.items():
+                    client.control(
+                        "rebalance", component=component, parallelism=par
+                    )
 
     # ---- teardown ------------------------------------------------------------
 
     def drain(self, timeout_s: float = 30.0) -> bool:
+        self._activated = False  # a recovery mid-drain must not re-emit
         for c in self.clients:
             c.control("deactivate")
         ok = True
@@ -183,30 +340,38 @@ class DistCluster:
 
     def activate(self) -> None:
         """Resume spouts after a deactivate/drain (Storm's 'activate')."""
+        self._activated = True
         for c in self.clients:
             c.control("activate")
 
     def kill(self, wait_secs: float = 0.0) -> None:
+        self._recipe = None  # a recovery after kill must not resurrect it
+        self._rebalances.clear()
         for c in self.clients:
             c.control("kill", wait_secs=wait_secs)
 
     def shutdown(self) -> None:
-        for c in self.clients:
-            try:
-                c.control("shutdown", timeout=5.0)
-            except Exception:
-                pass
-            c.close()
-        for p in self.procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-        for f in self._stderr_files:
-            f.close()
-        self._stderr_files.clear()
-        self.procs.clear()
-        self.clients.clear()
+        self._closing = True  # recoveries that start after this are no-ops
+        self.stop_monitor()
+        with self._lock:  # serialize against any still-running recovery
+            for c in self.clients:
+                try:
+                    c.control("shutdown", timeout=5.0)
+                except Exception:
+                    pass
+                c.close()
+            for p in self.procs:
+                if p is None:
+                    continue
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            for f in self._stderr_files:
+                f.close()
+            self._stderr_files.clear()
+            self.procs.clear()
+            self.clients.clear()
 
     def __enter__(self) -> "DistCluster":
         return self

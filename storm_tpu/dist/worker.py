@@ -270,6 +270,30 @@ class DistRuntime(TopologyRuntime):
                     self.groups[spec.component_id],
                 )
 
+    async def replace_peer(self, idx: int, addr: str) -> None:
+        """Point everything aimed at worker ``idx`` to its replacement at
+        ``addr`` (the worker came back at a new port after a crash).
+
+        Swaps the :class:`PeerSender` in place — the senders dict is shared
+        with :class:`DistLedger`, so ack routing follows automatically — and
+        repoints the proxy inboxes of every component placed on ``idx``.
+        Tuples queued in the dead sender are dropped with it: they were lost
+        in flight anyway, and the spout ledger's timeout replays their trees
+        (at-least-once, same story as a worker crash under Storm)."""
+        old = self.senders.get(idx)
+        sender = PeerSender(addr)
+        self.senders[idx] = sender
+        sender.start()
+        for spec in self.topology.specs.values():
+            if spec.is_spout or self._local(spec.component_id):
+                continue
+            if self.placement.get(spec.component_id, 0) != idx:
+                continue
+            for inbox in self.groups[spec.component_id].inboxes:
+                inbox._sender = sender
+        if old is not None:
+            await old.stop()
+
     async def resize_remote_group(self, component: str, parallelism: int) -> None:
         """Resize this worker's proxy-inbox view of a component hosted
         elsewhere, so groupings route over the component's new task count."""
@@ -441,6 +465,11 @@ class WorkerServer:
             else:
                 self._run_on_loop(self.rt.resize_remote_group(component, new))
             return {"ok": True, "previous": prev}
+        if cmd == "update_peer":
+            self._run_on_loop(
+                self.rt.replace_peer(int(req["idx"]), req["addr"])
+            )
+            return {"ok": True}
         if cmd == "metrics":
             return {"metrics": self.rt.metrics.snapshot()}
         if cmd == "health":
