@@ -136,6 +136,8 @@ class TopologyConfig:
     message_timeout_s: float = 30.0  # at-least-once replay timeout
     inbox_capacity: int = 4096  # bounded executor queues (backpressure)
     tick_interval_s: float = 0.0  # 0 = no tick tuples
+    checkpoint_interval_s: float = 5.0  # stateful-bolt checkpoint cadence
+    state_dir: str = ""  # durable bolt-state dir; "" = in-memory backend
 
 
 @dataclass

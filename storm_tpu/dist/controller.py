@@ -109,23 +109,26 @@ class DistCluster:
         bad = {c: w for c, w in placement.items() if w >= len(self.clients)}
         if bad:
             raise ValueError(f"placement onto unknown workers: {bad}")
-        self._placement = placement
-        self._recipe = {
-            "name": name, "config": cfg.to_dict(), "builder": builder,
-        }
-        for c in self.clients:
-            c.control(
-                "submit",
-                name=name,
-                config=cfg.to_dict(),
-                placement=placement,
-                peers=self.peers,
-                builder=builder,
-            )
-        for c in self.clients:
-            c.control("start_bolts")
-        for c in self.clients:
-            c.control("start_spouts")
+        with self._lock:
+            self._placement = placement
+            self._recipe = {
+                "name": name, "config": cfg.to_dict(), "builder": builder,
+            }
+            self._activated = True  # fresh topology starts active
+            self._rebalances.clear()
+            for c in self.clients:
+                c.control(
+                    "submit",
+                    name=name,
+                    config=cfg.to_dict(),
+                    placement=placement,
+                    peers=self.peers,
+                    builder=builder,
+                )
+            for c in self.clients:
+                c.control("start_bolts")
+            for c in self.clients:
+                c.control("start_spouts")
         return placement
 
     def _auto_place(self, cfg: Config, builder: str) -> Dict[str, int]:
@@ -180,19 +183,20 @@ class DistCluster:
             # Validate before touching ANY worker: peers' proxy views are
             # resized with no rollback, so a bad value must never reach them.
             raise ValueError("parallelism must be >= 1")
-        w = self._placement.get(component)
-        if w is None:
-            raise KeyError(component)
-        host = self.clients[w]
-        current = host.control("parallelism", component=component)["parallelism"]
-        others = [c for i, c in enumerate(self.clients) if i != w]
-        targets = [host, *others] if parallelism >= current else [*others, host]
-        for c in targets:
-            c.control("rebalance", component=component, parallelism=parallelism)
-        # Recorded so a recovered worker rebuilds at the LIVE parallelism,
-        # not the submit-time one (else survivors route to tasks the
-        # replacement doesn't have).
-        self._rebalances[component] = parallelism
+        with self._lock:  # serialize against a recovery in flight
+            w = self._placement.get(component)
+            if w is None:
+                raise KeyError(component)
+            host = self.clients[w]
+            current = host.control("parallelism", component=component)["parallelism"]
+            others = [c for i, c in enumerate(self.clients) if i != w]
+            targets = [host, *others] if parallelism >= current else [*others, host]
+            for c in targets:
+                c.control("rebalance", component=component, parallelism=parallelism)
+            # Recorded so a recovered worker rebuilds at the LIVE
+            # parallelism, not the submit-time one (else survivors route to
+            # tasks the replacement doesn't have).
+            self._rebalances[component] = parallelism
 
     # ---- failure detection + elastic recovery (SURVEY.md §5.3) ---------------
 
@@ -282,17 +286,29 @@ class DistCluster:
             self.peers[idx] = client.target
             # Surviving peers aim their senders at the replacement. A peer
             # left pointing at the dead address would replay its tuples
-            # forever, so retry; if a peer stays unreachable, kill the
+            # forever, so retry; if a LIVE peer stays unreachable, kill the
             # replacement and raise — its dead heartbeat makes the monitor
             # re-run the whole recovery rather than half-wire the cluster.
+            # A peer that is itself dead is skipped: its own recovery
+            # re-ships the fresh peers table (which includes this
+            # replacement's address), so rewiring it here is both
+            # impossible and unnecessary — and aborting on it would
+            # livelock two simultaneous deaths against each other.
             for i, c in enumerate(self.clients):
-                if i == idx:
-                    continue
+                if i == idx or self._recipe is None:
+                    continue  # no topology -> nothing to rewire
                 for attempt in range(3):
                     try:
                         c.control("update_peer", idx=idx, addr=client.target)
                         break
                     except Exception as e:
+                        try:
+                            c.control("ping", timeout=2.0)
+                        except Exception:
+                            log.warning(
+                                "peer %d is down too; its own recovery "
+                                "will rewire it", i)
+                            break
                         if attempt == 2:
                             proc.kill()
                             raise RuntimeError(
@@ -330,25 +346,28 @@ class DistCluster:
     # ---- teardown ------------------------------------------------------------
 
     def drain(self, timeout_s: float = 30.0) -> bool:
-        self._activated = False  # a recovery mid-drain must not re-emit
-        for c in self.clients:
-            c.control("deactivate")
-        ok = True
-        for c in self.clients:
-            ok = c.control("drain", timeout_s=timeout_s).get("ok", False) and ok
-        return ok
+        with self._lock:  # serialize against a recovery in flight
+            self._activated = False  # a recovery mid-drain must not re-emit
+            for c in self.clients:
+                c.control("deactivate")
+            ok = True
+            for c in self.clients:
+                ok = c.control("drain", timeout_s=timeout_s).get("ok", False) and ok
+            return ok
 
     def activate(self) -> None:
         """Resume spouts after a deactivate/drain (Storm's 'activate')."""
-        self._activated = True
-        for c in self.clients:
-            c.control("activate")
+        with self._lock:
+            self._activated = True
+            for c in self.clients:
+                c.control("activate")
 
     def kill(self, wait_secs: float = 0.0) -> None:
-        self._recipe = None  # a recovery after kill must not resurrect it
-        self._rebalances.clear()
-        for c in self.clients:
-            c.control("kill", wait_secs=wait_secs)
+        with self._lock:
+            self._recipe = None  # a recovery after kill must not resurrect it
+            self._rebalances.clear()
+            for c in self.clients:
+                c.control("kill", wait_secs=wait_secs)
 
     def shutdown(self) -> None:
         self._closing = True  # recoveries that start after this are no-ops

@@ -66,6 +66,9 @@ class TopologyRuntime:
         self.topology = topology
         self.config = config
         self.metrics = MetricsRegistry()
+        from storm_tpu.runtime.state import make_backend
+
+        self.state_backend = make_backend(config.topology.state_dir)
         self.ledger = AckLedger(timeout_s=config.topology.message_timeout_s)
         self.router = Router()
         self.groups: Dict[str, TargetGroup] = {}
@@ -161,6 +164,8 @@ class TopologyRuntime:
                 if died(e):
                     if e._tick_task is not None:
                         e._tick_task.cancel()  # or the old ticker keeps feeding the inbox
+                    if e._ckpt_task is not None:
+                        e._ckpt_task.cancel()  # same for the checkpoint ticker
 
                     replace(
                         cid, i, execs, e,

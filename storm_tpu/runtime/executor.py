@@ -23,6 +23,7 @@ from storm_tpu.runtime.tuples import TickTuple, Tuple, is_tick
 log = logging.getLogger("storm_tpu.executor")
 
 _STOP = object()  # inbox sentinel
+_CKPT = object()  # checkpoint sentinel: snapshot between tuples
 
 
 class BoltExecutor:
@@ -48,6 +49,8 @@ class BoltExecutor:
         self.tick_interval_s = tick_interval_s
         self._task: Optional[asyncio.Task] = None
         self._tick_task: Optional[asyncio.Task] = None
+        self._ckpt_task: Optional[asyncio.Task] = None
+        self._stateful = False
         self.collector = OutputCollector(runtime, component_id, task_index)
         self.collector.set_output_fields(bolt.declare_output_fields())
 
@@ -60,19 +63,56 @@ class BoltExecutor:
             self.rt.metrics,
         )
         self.bolt.prepare(ctx, self.collector)
+        self._init_state()
         self._task = asyncio.create_task(
             self._run(), name=f"{self.component_id}[{self.task_index}]"
         )
         interval = self.tick_interval_s or getattr(self.bolt, "tick_interval_s", 0.0)
         if interval > 0:
             self._tick_task = asyncio.create_task(self._ticker(interval))
+        ckpt = self.rt.config.topology.checkpoint_interval_s
+        if self._stateful and ckpt > 0:
+            self._ckpt_task = asyncio.create_task(
+                self._ticker(ckpt, payload=_CKPT)
+            )
 
-    async def _ticker(self, interval: float) -> None:
+    def _init_state(self) -> None:
+        """Restore + hand state to a StatefulBolt (Storm's prepare ->
+        initState ordering): a replacement executor (supervisor sweep,
+        rebalance, recovered worker) resumes from the last checkpoint."""
+        from storm_tpu.runtime.state import KeyValueState, StatefulBolt
+
+        self._stateful = isinstance(self.bolt, StatefulBolt)
+        self._state_version = 0
+        if not self._stateful:
+            return
+        got = self.rt.state_backend.load(self.component_id, self.task_index)
+        if got is not None:
+            self._state_version, snap = got
+            state = KeyValueState(snap)
+        else:
+            state = KeyValueState()
+        self._state = state
+        self.bolt.init_state(state)
+
+    def _checkpoint(self) -> None:
+        if not self._state.dirty:
+            return
+        self.bolt.pre_checkpoint()
+        self._state_version += 1
+        self.rt.state_backend.save(
+            self.component_id, self.task_index,
+            self._state_version, self._state.snapshot(),
+        )
+        self._state.dirty = False
+        self.rt.metrics.counter(self.component_id, "checkpoints").inc()
+
+    async def _ticker(self, interval: float, payload: Any = None) -> None:
         while True:
             await asyncio.sleep(interval)
             # Non-blocking: a full inbox skips the tick rather than stalling.
             try:
-                self.inbox.put_nowait(TickTuple())
+                self.inbox.put_nowait(payload if payload is not None else TickTuple())
             except asyncio.QueueFull:
                 pass
 
@@ -86,6 +126,12 @@ class BoltExecutor:
             item = await self.inbox.get()
             if item is _STOP:
                 break
+            if item is _CKPT:
+                try:
+                    self._checkpoint()
+                except Exception as e:
+                    self.rt.report_error(self.component_id, self.task_index, e)
+                continue
             t: Tuple = item
             try:
                 if is_tick(t):
@@ -105,6 +151,8 @@ class BoltExecutor:
     async def stop(self, drain: bool) -> None:
         if self._tick_task:
             self._tick_task.cancel()
+        if self._ckpt_task:
+            self._ckpt_task.cancel()
         if self._task is None:
             return
         if drain:
@@ -119,6 +167,14 @@ class BoltExecutor:
                 await asyncio.wait_for(self.bolt.flush(), timeout=30.0)
             except Exception as e:
                 log.warning("flush error in %s: %s", self.component_id, e)
+            if self._stateful:
+                # Final checkpoint: a graceful stop must not lose the tail
+                # of state updates since the last periodic snapshot.
+                try:
+                    self._checkpoint()
+                except Exception as e:
+                    log.warning("final checkpoint of %s failed: %s",
+                                self.component_id, e)
         else:
             self._task.cancel()
         try:

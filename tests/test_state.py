@@ -1,0 +1,197 @@
+"""Stateful-bolt tests: KeyValueState, checkpoint backends, restore across
+supervisor restarts and across topology restarts (durable file backend).
+
+The reference checkpoints nothing (SURVEY.md §5.4); this is the Storm
+``IStatefulBolt``/``KeyValueState`` capability owned by the layer-1 runtime."""
+
+import asyncio
+
+import pytest
+
+from storm_tpu.config import Config
+from storm_tpu.runtime import (
+    FileStateBackend,
+    KeyValueState,
+    MemoryStateBackend,
+    StatefulBolt,
+    TopologyBuilder,
+    Values,
+)
+from storm_tpu.runtime.chaos import ChaosMonkey
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+from test_runtime import ListSpout
+
+
+class CountBolt(StatefulBolt):
+    """Word-count: the canonical stateful operator."""
+
+    async def execute(self, t):
+        key = t.get("message")
+        self.state.put(key, self.state.get(key, 0) + 1)
+        self.collector.ack(t)
+
+
+# ---- unit: state + backends --------------------------------------------------
+
+
+def test_kv_state_basics():
+    s = KeyValueState()
+    assert not s.dirty
+    s.put("a", 1)
+    s.put("b", {"nested": [1, 2]})
+    assert s.dirty
+    assert s.get("a") == 1
+    assert s.get("missing", 42) == 42
+    assert "b" in s and len(s) == 2
+    snap = s.snapshot()
+    s.delete("a")
+    assert "a" not in s
+    assert snap["a"] == 1  # snapshot unaffected by later mutation
+    restored = KeyValueState(snap)
+    assert restored.get("a") == 1 and not restored.dirty
+
+
+def test_memory_backend_roundtrip():
+    b = MemoryStateBackend()
+    assert b.load("c", 0) is None
+    b.save("c", 0, 3, {"k": 1})
+    assert b.load("c", 0) == (3, {"k": 1})
+    b.save("c", 1, 1, {"other": True})
+    assert b.load("c", 0) == (3, {"k": 1})  # tasks isolated
+
+
+def test_file_backend_roundtrip(tmp_path):
+    b = FileStateBackend(str(tmp_path))
+    assert b.load("count-bolt", 2) is None
+    b.save("count-bolt", 2, 1, {"x": [1, 2, 3]})
+    b.save("count-bolt", 2, 2, {"x": [1, 2, 3, 4]})
+    # fresh instance reads what a prior process wrote (durability)
+    b2 = FileStateBackend(str(tmp_path))
+    assert b2.load("count-bolt", 2) == (2, {"x": [1, 2, 3, 4]})
+    # no stray tmp files from the atomic write
+    assert all(not p.name.endswith(".tmp") for p in tmp_path.iterdir())
+
+
+# ---- integration: checkpoint + restore ---------------------------------------
+
+
+def _config(**topo):
+    cfg = Config()
+    cfg.topology.message_timeout_s = topo.pop("message_timeout_s", 2.0)
+    cfg.topology.checkpoint_interval_s = topo.pop("checkpoint_interval_s", 0.05)
+    for k, v in topo.items():
+        setattr(cfg.topology, k, v)
+    return cfg
+
+
+def test_supervisor_restore_after_crash(run):
+    """Crash the stateful bolt's executor mid-stream: the supervisor
+    replaces it, the replacement restores the last checkpoint, and the
+    in-flight tuple replays — counts end >= exact (at-least-once)."""
+
+    async def scenario():
+        items = ["a", "b", "a", "c", "a", "b"]
+        spout = ListSpout(items, replay_on_fail=True)
+
+        builder = TopologyBuilder()
+        builder.set_spout("spout", spout, 1)
+        builder.set_bolt("count", CountBolt(), 1).shuffle_grouping("spout")
+        cfg = _config()
+
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("stateful", cfg, builder.build())
+        try:
+            # Phase 1: everything counted and at least one checkpoint taken.
+            for _ in range(400):
+                sp = rt.spout_execs["spout"][0].spout
+                if len(sp.acked) >= len(items) and \
+                        rt.metrics.snapshot().get("count", {}).get("checkpoints", 0) >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            got = rt.state_backend.load("count", 0)
+            assert got is not None
+            version, snap = got
+            assert sum(snap.values()) == len(items)
+            assert snap["a"] == 3
+
+            # Phase 2: chaos-kill the executor on its next tuple.
+            ChaosMonkey(rt).crash_bolt("count", 0)
+            rt.spout_execs["spout"][0].spout.queue.extend(["c", "b"])
+            for _ in range(400):
+                snap2 = rt.metrics.snapshot().get("count", {})
+                if snap2.get("executor_restarts", 0) >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert rt.metrics.snapshot()["count"]["executor_restarts"] >= 1
+
+            # Phase 3: replacement restored state; replayed + new tuples
+            # land on top of it. At-least-once: counts >= exact.
+            for _ in range(400):
+                got = rt.state_backend.load("count", 0)
+                if got and got[1].get("c", 0) >= 2 and got[1].get("b", 0) >= 3:
+                    break
+                await asyncio.sleep(0.05)
+            version2, final = rt.state_backend.load("count", 0)
+            assert version2 > version
+            assert final["a"] >= 3 and final["b"] >= 3 and final["c"] >= 2
+        finally:
+            await cluster.shutdown()
+
+    run(scenario(), timeout=60)
+
+
+def test_durable_state_across_topology_restart(run, tmp_path):
+    """File backend: a graceful kill checkpoints the tail; a new topology
+    (fresh process-equivalent) resumes the counts."""
+
+    async def scenario():
+        cfg = _config(checkpoint_interval_s=30.0)  # only the final checkpoint
+        cfg.topology.state_dir = str(tmp_path)
+
+        async def run_once(items):
+            builder = TopologyBuilder()
+            builder.set_spout("spout", ListSpout(items), 1)
+            builder.set_bolt("count", CountBolt(), 1).shuffle_grouping("spout")
+            cluster = AsyncLocalCluster()
+            rt = await cluster.submit("durable", cfg, builder.build())
+            for _ in range(400):
+                if len(rt.spout_execs["spout"][0].spout.acked) >= len(items):
+                    break
+                await asyncio.sleep(0.05)
+            await cluster.kill("durable", wait_secs=5.0)  # graceful: checkpoints
+
+        await run_once(["x", "y", "x"])
+        await run_once(["y", "z"])
+
+        got = FileStateBackend(str(tmp_path)).load("count", 0)
+        assert got is not None
+        _, counts = got
+        assert counts == {"x": 2, "y": 2, "z": 1}
+
+    run(scenario(), timeout=60)
+
+
+def test_non_stateful_bolt_untouched(run):
+    """Plain bolts: no state machinery, no checkpoint files, no counter."""
+
+    async def scenario():
+        from test_runtime import CaptureBolt
+
+        CaptureBolt.seen = None
+        builder = TopologyBuilder()
+        builder.set_spout("spout", ListSpout(["m"]), 1)
+        builder.set_bolt("cap", CaptureBolt(), 1).shuffle_grouping("spout")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("plain", _config(), builder.build())
+        try:
+            for _ in range(200):
+                if CaptureBolt.seen:
+                    break
+                await asyncio.sleep(0.05)
+            assert rt.state_backend.load("cap", 0) is None
+            assert "checkpoints" not in rt.metrics.snapshot().get("cap", {})
+        finally:
+            await cluster.shutdown()
+
+    run(scenario(), timeout=30)

@@ -113,7 +113,10 @@ def test_time_windows_fire_on_ticks(run):
     CollectWindows.windows = None
     items = [f"t{i}" for i in range(5)]
     acked, failed = run(
-        _run_windowed(items, CollectWindows(window_s=0.2, slide_s=0.1))
+        # Generous window/slide: a loop stall (suite runs in one process;
+        # earlier modules leave JAX threads around) must not expire tuples
+        # between window fires.
+        _run_windowed(items, CollectWindows(window_s=0.6, slide_s=0.3))
     )
     assert sorted(acked) == sorted(items)
     assert failed == []
