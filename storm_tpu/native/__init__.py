@@ -59,6 +59,27 @@ def _load() -> Optional[ctypes.CDLL]:
             ]
         except AttributeError:  # stale .so without the serializer
             pass
+        try:
+            lib.stpu_tensor_encode.restype = ctypes.c_void_p
+            lib.stpu_tensor_encode.argtypes = [
+                ctypes.c_void_p,  # data
+                ctypes.c_int,  # dtype code
+                ctypes.c_int,  # ndim
+                ctypes.POINTER(ctypes.c_int64),  # shape
+                ctypes.POINTER(ctypes.c_size_t),  # out length
+            ]
+            lib.stpu_tensor_decode.restype = ctypes.c_int
+            lib.stpu_tensor_decode.argtypes = [
+                ctypes.c_void_p,  # buf (address; caller keeps the buffer alive)
+                ctypes.c_size_t,  # len
+                ctypes.POINTER(ctypes.c_int),  # out dtype
+                ctypes.POINTER(ctypes.c_int),  # out ndim
+                ctypes.POINTER(ctypes.c_int64),  # out shape[_MAX_RANK]
+                ctypes.POINTER(ctypes.c_size_t),  # out body offset
+                ctypes.POINTER(ctypes.c_size_t),  # out body length
+            ]
+        except AttributeError:  # stale .so without the tensor marshaller
+            pass
         _lib = lib
     except OSError:
         _lib = None
@@ -109,6 +130,89 @@ def parse_instances_native(payload: str | bytes) -> Optional[np.ndarray]:
     ctypes.memmove(out.ctypes.data, ptr, n * 4)
     lib.stpu_free(ptr)
     return out.reshape(shp)
+
+
+# Dtype codes shared with arrow_tensor.cpp (enum DType).
+_DTYPE_TO_CODE = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int8): 4,
+    np.dtype(np.uint16): 5,
+    np.dtype(np.int16): 6,
+    np.dtype(np.uint32): 7,
+    np.dtype(np.int32): 8,
+    np.dtype(np.uint64): 9,
+    np.dtype(np.int64): 10,
+}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+
+
+def encode_tensor_native(x: np.ndarray) -> Optional[bytes]:
+    """Encode a NumPy array as an Arrow IPC tensor message with the C++
+    marshaller (SURVEY.md §2.2: the zero-copy host↔engine boundary). Returns
+    ``None`` when the native library is unavailable or the dtype is outside
+    Arrow's tensor element types (caller falls back to pyarrow)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "stpu_tensor_encode"):
+        return None
+    code = _DTYPE_TO_CODE.get(x.dtype)
+    if code is None or x.ndim < 1 or x.ndim > _MAX_RANK:
+        return None
+    x = np.ascontiguousarray(x)
+    shape = (ctypes.c_int64 * _MAX_RANK)(*x.shape, *([0] * (_MAX_RANK - x.ndim)))
+    length = ctypes.c_size_t(0)
+    ptr = lib.stpu_tensor_encode(
+        x.ctypes.data, code, x.ndim, shape, ctypes.byref(length)
+    )
+    if not ptr:
+        return None
+    out = ctypes.string_at(ptr, length.value)
+    lib.stpu_free(ptr)
+    return out
+
+
+_RC_UNSUPPORTED = 100  # valid Arrow tensor, but a layout we don't view raw
+
+
+def decode_tensor_native(buf) -> Optional[np.ndarray]:
+    """Decode an Arrow IPC tensor message with the C++ parser.
+
+    ``buf`` may be ``bytes``, ``bytearray``, or ``memoryview`` (any buffer
+    object). The returned array is a zero-copy view over ``buf``'s body
+    bytes. Returns ``None`` when the native library is unavailable OR the
+    message is valid but uses a layout the raw-view path doesn't support
+    (e.g. Fortran-order strides) — callers fall back to pyarrow. Raises
+    ``ValueError`` on genuinely malformed input."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "stpu_tensor_decode"):
+        return None
+    # frombuffer accepts any buffer object without copying and keeps `buf`
+    # alive via the returned array's .base chain.
+    raw = np.frombuffer(buf, dtype=np.uint8)
+    dtype = ctypes.c_int(0)
+    ndim = ctypes.c_int(0)
+    shape = (ctypes.c_int64 * _MAX_RANK)()
+    body_off = ctypes.c_size_t(0)
+    body_len = ctypes.c_size_t(0)
+    rc = lib.stpu_tensor_decode(
+        raw.ctypes.data,
+        raw.size,
+        ctypes.byref(dtype),
+        ctypes.byref(ndim),
+        shape,
+        ctypes.byref(body_off),
+        ctypes.byref(body_len),
+    )
+    if rc == _RC_UNSUPPORTED:
+        return None
+    if rc != 0:
+        raise ValueError(f"malformed Arrow tensor message (native rc={rc})")
+    dt = _CODE_TO_DTYPE[dtype.value]
+    shp = tuple(int(shape[i]) for i in range(ndim.value))
+    view = raw[body_off.value : body_off.value + body_len.value]
+    return view.view(dt).reshape(shp)
 
 
 def format_predictions_native(arr: np.ndarray) -> Optional[str]:

@@ -1,23 +1,35 @@
 """Arrow zero-copy tensor marshalling for the gRPC boundary.
 
 The reference's host<->engine boundary is two JNI float-array copies per
-tuple (InferenceBolt.java:80, :86). Here the boundary is Arrow IPC tensors:
-``encode_tensor`` writes the C-contiguous buffer with no element-wise
+tuple (InferenceBolt.java:80, :86). Here the boundary is Arrow IPC tensors,
+marshalled by the **C++ layer** (storm_tpu/native/arrow_tensor.cpp — the
+SURVEY.md §2.2 obligation: native marshalling, not a Python stand-in):
+``encode_tensor`` writes the flatbuffer metadata + body with no element-wise
 conversion, and ``decode_tensor`` returns a NumPy view over the received
 buffer (zero-copy on the read side) ready for ``jax.device_put``. This is
 the marshalling path a JVM/Storm front-end would use to hand batches to the
 co-located TPU worker (BASELINE.json north star).
+
+When the native library is not built, both directions fall back to pyarrow
+(wire-identical — the C++ marshaller is round-trip tested against pyarrow
+in tests/test_native.py).
 """
 
 from __future__ import annotations
 
 import numpy as np
-import pyarrow as pa
+
+from storm_tpu.native import decode_tensor_native, encode_tensor_native
 
 
 def encode_tensor(x: np.ndarray) -> bytes:
-    """NumPy array -> Arrow IPC tensor bytes."""
+    """NumPy array -> Arrow IPC tensor message bytes (C++ fast path)."""
     x = np.ascontiguousarray(x)
+    out = encode_tensor_native(x)
+    if out is not None:
+        return out
+    import pyarrow as pa
+
     tensor = pa.Tensor.from_numpy(x)
     sink = pa.BufferOutputStream()
     pa.ipc.write_tensor(tensor, sink)
@@ -26,5 +38,10 @@ def encode_tensor(x: np.ndarray) -> bytes:
 
 def decode_tensor(buf: bytes) -> np.ndarray:
     """Arrow IPC tensor bytes -> NumPy view (zero-copy over the buffer)."""
+    out = decode_tensor_native(buf)
+    if out is not None:
+        return out
+    import pyarrow as pa
+
     tensor = pa.ipc.read_tensor(pa.py_buffer(buf))
     return tensor.to_numpy()

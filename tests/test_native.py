@@ -130,3 +130,134 @@ def test_format_predictions_1d_and_nonfinite():
     # json module accepts NaN/Infinity tokens (python json.dumps emits them too)
     back = decode_predictions(s).data
     assert np.isnan(back[0, 0]) and np.isinf(back[0, 1]) and back[0, 2] < 0
+
+
+# ---------------------------------------------------------------------------
+# Arrow IPC tensor marshaller (arrow_tensor.cpp) — the C++ zero-copy
+# host<->engine boundary (SURVEY.md §2.2), wire-compatible with pyarrow.
+# ---------------------------------------------------------------------------
+
+
+def _need_native_tensor():
+    from storm_tpu.native import _load, native_available
+
+    if not native_available() or not hasattr(_load(), "stpu_tensor_encode"):
+        pytest.skip("native tensor marshaller not built")
+
+
+def test_arrow_tensor_roundtrip_all_dtypes():
+    _need_native_tensor()
+    from storm_tpu.native import decode_tensor_native, encode_tensor_native
+
+    rng = np.random.RandomState(0)
+    dtypes = [
+        np.float32, np.float64, np.float16, np.uint8, np.int8, np.uint16,
+        np.int16, np.uint32, np.int32, np.uint64, np.int64,
+    ]
+    for dt in dtypes:
+        for shp in [(4,), (2, 3), (1, 28, 28, 1), (3, 1, 2)]:
+            x = (rng.rand(*shp) * 100).astype(dt)
+            y = decode_tensor_native(encode_tensor_native(x))
+            assert y.dtype == x.dtype and y.shape == x.shape
+            np.testing.assert_array_equal(y, x)
+
+
+def test_arrow_tensor_pyarrow_cross_compat():
+    _need_native_tensor()
+    pa = pytest.importorskip("pyarrow")
+    from storm_tpu.native import decode_tensor_native, encode_tensor_native
+
+    rng = np.random.RandomState(1)
+    for dt in [np.float32, np.float16, np.uint8, np.int64]:
+        x = (rng.rand(2, 5, 3) * 50).astype(dt)
+        # native writer -> pyarrow reader
+        z = pa.ipc.read_tensor(pa.py_buffer(encode_tensor_native(x))).to_numpy()
+        np.testing.assert_array_equal(z, x)
+        # pyarrow writer -> native reader
+        sink = pa.BufferOutputStream()
+        pa.ipc.write_tensor(pa.Tensor.from_numpy(x), sink)
+        w = decode_tensor_native(sink.getvalue().to_pybytes())
+        assert w.dtype == x.dtype
+        np.testing.assert_array_equal(w, x)
+
+
+def test_arrow_tensor_decode_is_zero_copy_view():
+    _need_native_tensor()
+    from storm_tpu.native import decode_tensor_native, encode_tensor_native
+
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    y = decode_tensor_native(encode_tensor_native(x))
+    # A view over the message bytes: no ownership, read-only.
+    assert not y.flags.owndata
+    assert not y.flags.writeable
+    np.testing.assert_array_equal(y, x)
+
+
+def test_arrow_tensor_malformed_rejected():
+    _need_native_tensor()
+    from storm_tpu.native import decode_tensor_native
+
+    for bad in [b"", b"\x00" * 12, b"\xff\xff\xff\xff\x10\x00\x00\x00" + b"\x00" * 32,
+                b"garbage" * 5]:
+        with pytest.raises(ValueError):
+            decode_tensor_native(bad)
+
+
+def test_marshal_prefers_native_path(monkeypatch):
+    _need_native_tensor()
+    from storm_tpu.serve import marshal
+
+    calls = []
+    real = marshal.encode_tensor_native
+
+    def spy(x):
+        calls.append(x.shape)
+        return real(x)
+
+    monkeypatch.setattr(marshal, "encode_tensor_native", spy)
+    x = np.ones((2, 4), np.float32)
+    buf = marshal.encode_tensor(x)
+    assert calls == [(2, 4)]
+    np.testing.assert_array_equal(marshal.decode_tensor(buf), x)
+
+
+def test_arrow_tensor_fortran_order_falls_back():
+    _need_native_tensor()
+    pa = pytest.importorskip("pyarrow")
+    from storm_tpu.native import decode_tensor_native
+    from storm_tpu.serve.marshal import decode_tensor
+
+    x = np.asfortranarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+    sink = pa.BufferOutputStream()
+    pa.ipc.write_tensor(pa.Tensor.from_numpy(x), sink)
+    buf = sink.getvalue().to_pybytes()
+    # Valid-but-unsupported layout: native path declines (None), the public
+    # decode_tensor falls back to pyarrow and still returns the array.
+    assert decode_tensor_native(buf) is None
+    np.testing.assert_array_equal(decode_tensor(buf), x)
+
+
+def test_arrow_tensor_adversarial_dims_rejected():
+    _need_native_tensor()
+    from storm_tpu.native import decode_tensor_native, encode_tensor_native
+
+    good = encode_tensor_native(np.ones((2, 3), np.float32))
+    idx = good.find((2).to_bytes(8, "little", signed=True), 8)
+    assert idx > 0
+    for evil in (-1, 2**62):
+        patched = bytearray(good)
+        patched[idx : idx + 8] = evil.to_bytes(8, "little", signed=True)
+        with pytest.raises(ValueError):
+            decode_tensor_native(bytes(patched))
+
+
+def test_arrow_tensor_accepts_any_buffer_type():
+    _need_native_tensor()
+    from storm_tpu.native import decode_tensor_native, encode_tensor_native
+
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    buf = encode_tensor_native(x)
+    for cast in (bytes, bytearray, memoryview):
+        y = decode_tensor_native(cast(buf))
+        assert y is not None and not y.flags.owndata
+        np.testing.assert_array_equal(y, x)
