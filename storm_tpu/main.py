@@ -122,7 +122,8 @@ def _load_config(args) -> Config:
 
 
 async def _run_daemon(name: str, cfg: Config, duration: float,
-                      autoscale_target_ms: float = 0.0) -> None:
+                      autoscale_target_ms: float = 0.0,
+                      ui_port: int = -1) -> None:
     from storm_tpu.runtime.cluster import AsyncLocalCluster
 
     broker = _make_broker(cfg)
@@ -157,9 +158,16 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
             ).start()
             for infer_id, sink_id in pairs
         ]
+    ui = None
+    if ui_port >= 0:
+        from storm_tpu.runtime.ui import UIServer
+
+        ui = await UIServer(cluster, port=ui_port).start()
     print(f"topology {name!r} running "
           f"(model={desc}, broker={cfg.broker.kind}"
-          f"{', autoscaling' if scalers else ''})", file=sys.stderr)
+          f"{', autoscaling' if scalers else ''}"
+          f"{f', ui http://127.0.0.1:{ui.port}' if ui else ''})",
+          file=sys.stderr)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -170,6 +178,8 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
     await stop.wait()
 
     print("draining...", file=sys.stderr)
+    if ui is not None:
+        await ui.stop()
     for scaler in scalers:
         await scaler.stop()
     await rt.deactivate()
@@ -199,6 +209,9 @@ def main(argv=None) -> int:
                            "under this latency (0 = off); the runtime "
                            "equivalent of the reference's rebuild-with-more-"
                            "bolts scaling thesis (README.md:13-14)")
+    runp.add_argument("--ui-port", type=int, default=-1,
+                      help="serve the Storm-UI-equivalent HTTP status/admin "
+                           "API on this port (0 = ephemeral, -1 = off)")
 
     distp = sub.add_parser(
         "dist-run",
@@ -242,7 +255,7 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
         asyncio.run(_run_daemon(args.name, cfg, args.duration,
-                                args.autoscale_target_ms))
+                                args.autoscale_target_ms, args.ui_port))
         return 0
 
     if args.cmd == "dist-run":

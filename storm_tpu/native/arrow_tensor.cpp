@@ -375,10 +375,10 @@ int stpu_tensor_decode(const uint8_t* buf, size_t len, int* dtype, int* ndim,
       case 16: dt = is_signed ? DT_I16 : DT_U16; break;
       case 32: dt = is_signed ? DT_I32 : DT_U32; break;
       case 64: dt = is_signed ? DT_I64 : DT_U64; break;
-      default: return 7;
+      default: return 100;  // valid Arrow, not viewable raw -> fall back
     }
   } else {
-    return 7;  // unsupported tensor element type
+    return 100;  // unsupported element type (e.g. Decimal) -> fall back
   }
   int64_t itemsize = dtype_itemsize(dt);
 
@@ -387,7 +387,10 @@ int stpu_tensor_decode(const uint8_t* buf, size_t len, int* dtype, int* ndim,
   if (!f) return 8;
   size_t shape_vec = r.indirect(f);
   uint32_t n;
-  if (!r.rd(shape_vec, &n) || n < 1 || n > 8) return 8;
+  if (!r.rd(shape_vec, &n)) return 8;
+  // Rank 0 or >8 is valid Arrow but outside this fast path's shape buffer —
+  // signal fallback, not corruption.
+  if (n < 1 || n > 8) return 100;
   int64_t nelem = 1;
   for (uint32_t i = 0; i < n; i++) {
     size_t dim_tbl = r.indirect(shape_vec + 4 + 4 * i);
@@ -428,6 +431,11 @@ int stpu_tensor_decode(const uint8_t* buf, size_t len, int* dtype, int* ndim,
   int64_t buf_off, buf_len;
   if (!r.rd(f, &buf_off) || !r.rd(f + 8, &buf_len)) return 10;
   if (buf_off < 0 || buf_len < nbytes) return 10;
+  // The data range must sit inside the declared message body too, not just
+  // inside the raw buffer (a writer's Buffer and bodyLength must agree).
+  if (body_length > 0 &&
+      (buf_off > body_length || buf_len > body_length - buf_off))
+    return 10;
   size_t body_start = fb_start + static_cast<size_t>(meta_len);
   size_t off = body_start + static_cast<size_t>(buf_off);
   if (off > len || static_cast<size_t>(nbytes) > len - off) return 11;

@@ -357,9 +357,17 @@ class AsyncLocalCluster:
     def runtime(self, name: str) -> TopologyRuntime:
         return self._topologies[name]
 
+    @property
+    def runtimes(self) -> Dict[str, TopologyRuntime]:
+        """Live topologies by name (read-only view for the UI server)."""
+        return dict(self._topologies)
+
     async def kill(self, name: str, wait_secs: float = 0.0) -> None:
-        rt = self._topologies.pop(name)
-        await rt.kill(wait_secs)
+        # pop-with-default: a UI-initiated kill may race the daemon's own
+        # shutdown (or a second kill request); killing twice is a no-op.
+        rt = self._topologies.pop(name, None)
+        if rt is not None:
+            await rt.kill(wait_secs)
 
     async def shutdown(self) -> None:
         for name in list(self._topologies):

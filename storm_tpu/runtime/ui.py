@@ -1,0 +1,245 @@
+"""HTTP status + admin API — the Storm UI equivalent.
+
+The reference's only observability surface is whatever Storm UI exposes for
+free via storm-core (SURVEY.md §5.1/§5.5: execute latency, capacity, ack
+counts, plus activate/deactivate/rebalance/kill actions). This framework
+owns that surface: a dependency-free asyncio HTTP server over the running
+:class:`AsyncLocalCluster`, speaking JSON on routes modeled after Storm's
+REST API (``/api/v1/...``).
+
+Read routes
+    GET /healthz                              liveness of the server itself
+    GET /api/v1/cluster/summary               all topologies + uptime
+    GET /api/v1/topology/summary              per-topology health summaries
+    GET /api/v1/topology/{name}               health + component table
+    GET /api/v1/topology/{name}/metrics       full metrics snapshot
+    GET /api/v1/topology/{name}/errors        reported component errors
+
+Admin routes (POST, like Storm UI's topology actions)
+    POST /api/v1/topology/{name}/activate
+    POST /api/v1/topology/{name}/deactivate
+    POST /api/v1/topology/{name}/rebalance    body {"component":, "parallelism":}
+    POST /api/v1/topology/{name}/kill         body {"wait_secs": 0} (optional)
+
+Everything returns ``application/json``. The server binds 127.0.0.1 by
+default — expose it via a reverse proxy if needed; there is no auth layer,
+matching Storm UI's default posture.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+import logging
+
+log = logging.getLogger("storm_tpu.ui")
+
+_MAX_BODY = 1 << 20  # 1 MiB is far beyond any admin request
+
+
+class UIServer:
+    """Serve status/admin HTTP for the topologies in an AsyncLocalCluster."""
+
+    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.cluster = cluster
+        self.host = host
+        self.port = port  # replaced by the bound port after start()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._started = time.monotonic()
+        self._kill_tasks: set = set()
+
+    async def start(self) -> "UIServer":
+        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._started = time.monotonic()
+        log.info("ui listening on http://%s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for task in list(self._kill_tasks):
+            if not task.done():
+                await task
+
+    def _kill_done(self, task) -> None:
+        self._kill_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            log.error("topology kill failed: %r", task.exception())
+
+    # ---- HTTP plumbing -------------------------------------------------------
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            status, payload = await self._handle_one(reader)
+        except Exception as e:  # defense: a handler bug must not kill the loop
+            log.exception("ui handler error")
+            status, payload = 500, {"error": str(e)}
+        body = json.dumps(payload, default=str).encode()
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  405: "Method Not Allowed", 500: "Internal Server Error"}
+        head = (
+            f"HTTP/1.1 {status} {reason.get(status, 'OK')}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n"
+        )
+        try:
+            writer.write(head.encode() + body)
+            await writer.drain()
+            writer.close()
+            await writer.wait_closed()
+        except (ConnectionError, BrokenPipeError):
+            pass
+
+    async def _handle_one(self, reader) -> Tuple[int, Any]:
+        request_line = (await reader.readline()).decode("latin-1").strip()
+        parts = request_line.split()
+        if len(parts) != 3:
+            return 400, {"error": "malformed request line"}
+        method, target, _version = parts
+        content_length = 0
+        while True:
+            line = (await reader.readline()).decode("latin-1").strip()
+            if not line:
+                break
+            k, _, v = line.partition(":")
+            if k.strip().lower() == "content-length":
+                try:
+                    content_length = int(v)
+                except ValueError:
+                    return 400, {"error": "bad content-length"}
+                if content_length < 0:
+                    return 400, {"error": "bad content-length"}
+                content_length = min(content_length, _MAX_BODY)
+        body: Dict[str, Any] = {}
+        if content_length:
+            raw = await reader.readexactly(content_length)
+            if raw.strip():
+                try:
+                    body = json.loads(raw)
+                except ValueError:
+                    return 400, {"error": "body is not JSON"}
+        url = urlsplit(target)
+        query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+        return await self._route(method, url.path.rstrip("/"), query, body)
+
+    # ---- routing -------------------------------------------------------------
+
+    async def _route(self, method: str, path: str, query: Dict[str, str],
+                     body: Dict[str, Any]) -> Tuple[int, Any]:
+        if path == "/healthz":
+            return 200, {"status": "ok", "uptime_s": round(time.monotonic() - self._started, 3)}
+        if path == "/api/v1/cluster/summary":
+            return 200, self._cluster_summary()
+        if path == "/api/v1/topology/summary":
+            return 200, {"topologies": [self._topo_summary(rt)
+                                        for rt in self._runtimes().values()]}
+        if path.startswith("/api/v1/topology/"):
+            rest = path[len("/api/v1/topology/"):]
+            name, _, action = rest.partition("/")
+            rt = self._runtimes().get(name)
+            if rt is None:
+                return 404, {"error": f"no topology named {name!r}"}
+            if not action:
+                if method != "GET":
+                    return 405, {"error": "use GET"}
+                return 200, self._topo_detail(rt)
+            if action in ("metrics", "errors"):
+                if method != "GET":
+                    return 405, {"error": "use GET"}
+                if action == "metrics":
+                    return 200, rt.metrics.snapshot()
+                return 200, {"errors": [
+                    {"component": cid, "task": idx, "error": repr(err)}
+                    for cid, idx, err in rt.errors
+                ]}
+            if method != "POST":
+                return 405, {"error": "topology actions are POST"}
+            return await self._action(rt, action, {**query, **body})
+        return 404, {"error": f"no route {path!r}"}
+
+    def _runtimes(self):
+        return self.cluster.runtimes
+
+    def _cluster_summary(self) -> Dict[str, Any]:
+        return {
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "topologies": sorted(self._runtimes()),
+        }
+
+    def _topo_summary(self, rt) -> Dict[str, Any]:
+        h = rt.health()
+        active = all(
+            e._active for execs in rt.spout_execs.values() for e in execs
+        ) if rt.spout_execs else True
+        return {
+            "name": rt.name,
+            "status": "ACTIVE" if active else "INACTIVE",
+            "inflight_trees": h["inflight_trees"],
+            "components": {cid: c["tasks"] for cid, c in h["components"].items()},
+        }
+
+    def _topo_detail(self, rt) -> Dict[str, Any]:
+        summary = self._topo_summary(rt)
+        snap = rt.metrics.snapshot()
+        comps = {}
+        for cid, info in rt.health()["components"].items():
+            m = snap.get(cid, {})
+            comps[cid] = {
+                "tasks": info["tasks"],
+                "alive": info["alive"],
+                # the Storm UI headline columns, where the component has them
+                "executed": m.get("executed"),
+                "acked": m.get("tree_acked"),
+                "failed": m.get("tree_failed"),
+                "errors": m.get("errors"),
+                "execute_ms": m.get("execute_ms"),
+            }
+        summary["components"] = comps
+        summary["errors"] = len(rt.errors)
+        return summary
+
+    async def _action(self, rt, action: str,
+                      args: Dict[str, Any]) -> Tuple[int, Any]:
+        if action == "activate":
+            await rt.activate()
+            return 200, {"status": "ACTIVE"}
+        if action == "deactivate":
+            await rt.deactivate()
+            return 200, {"status": "INACTIVE"}
+        if action == "rebalance":
+            component = args.get("component")
+            try:
+                parallelism = int(args.get("parallelism", 0))
+            except (TypeError, ValueError):
+                return 400, {"error": "parallelism must be an int"}
+            if not component or parallelism < 1:
+                return 400, {"error": "need component and parallelism >= 1"}
+            try:
+                await rt.rebalance(component, parallelism)
+            except KeyError:
+                return 404, {"error": f"no component {component!r}"}
+            return 200, {"component": component, "parallelism": parallelism}
+        if action == "kill":
+            try:
+                wait_secs = float(args.get("wait_secs", 0.0))
+            except (TypeError, ValueError):
+                return 400, {"error": "wait_secs must be a number"}
+            # Mirror Storm UI: respond once the kill is initiated. Retain the
+            # task so its exceptions are observed (and a double-kill is a
+            # no-op at the cluster layer).
+            task = asyncio.ensure_future(
+                self.cluster.kill(rt.name, wait_secs=wait_secs)
+            )
+            self._kill_tasks.add(task)
+            task.add_done_callback(self._kill_done)
+            return 200, {"status": "KILLED", "wait_secs": wait_secs}
+        return 404, {"error": f"no action {action!r}"}

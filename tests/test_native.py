@@ -261,3 +261,17 @@ def test_arrow_tensor_accepts_any_buffer_type():
         y = decode_tensor_native(cast(buf))
         assert y is not None and not y.flags.owndata
         np.testing.assert_array_equal(y, x)
+
+
+def test_arrow_tensor_unsupported_rank_falls_back():
+    _need_native_tensor()
+    pa = pytest.importorskip("pyarrow")
+    from storm_tpu.native import decode_tensor_native
+    from storm_tpu.serve.marshal import decode_tensor
+
+    x = np.ones((1,) * 9, np.float32)  # rank 9 > the fast path's max rank 8
+    sink = pa.BufferOutputStream()
+    pa.ipc.write_tensor(pa.Tensor.from_numpy(x), sink)
+    buf = sink.getvalue().to_pybytes()
+    assert decode_tensor_native(buf) is None  # fallback signal, not an error
+    np.testing.assert_array_equal(decode_tensor(buf), x)

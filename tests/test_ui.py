@@ -1,0 +1,213 @@
+"""Storm-UI-equivalent HTTP API (runtime/ui.py): status, metrics, errors,
+and the activate/deactivate/rebalance/kill admin actions (SURVEY.md §5.1/§5.5
+— the observability surface the reference got for free from Storm UI)."""
+
+import asyncio
+import json
+
+import pytest
+
+from storm_tpu.config import Config
+from storm_tpu.runtime import Bolt, Spout, TopologyBuilder, Values
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+from storm_tpu.runtime.ui import UIServer
+
+
+class TrickleSpout(Spout):
+    """Emits integers forever, slowly."""
+
+    def open(self, context, collector):
+        super().open(context, collector)
+        self.n = 0
+
+    async def next_tuple(self):
+        await asyncio.sleep(0.01)
+        await self.collector.emit(Values([self.n]), msg_id=self.n)
+        self.n += 1
+        return True
+
+    def ack(self, msg_id):
+        pass
+
+    def fail(self, msg_id):
+        pass
+
+
+class EchoBolt(Bolt):
+    async def execute(self, t):
+        await self.collector.emit(Values([t.get("message")]), anchors=[t])
+        self.collector.ack(t)
+
+
+async def _http(port, method, path, body=None):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    payload = json.dumps(body).encode() if body is not None else b""
+    req = (
+        f"{method} {path} HTTP/1.1\r\nHost: localhost\r\n"
+        f"Content-Length: {len(payload)}\r\nConnection: close\r\n\r\n"
+    ).encode() + payload
+    writer.write(req)
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body_bytes = raw.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    return status, json.loads(body_bytes)
+
+
+async def _cluster_with_ui():
+    tb = TopologyBuilder()
+    tb.set_spout("spout", TrickleSpout(), parallelism=1)
+    tb.set_bolt("echo", EchoBolt(), parallelism=2).shuffle_grouping("spout")
+    cluster = AsyncLocalCluster()
+    await cluster.submit("demo", Config(), tb.build())
+    ui = await UIServer(cluster, port=0).start()
+    return cluster, ui
+
+
+def test_ui_status_routes(run):
+    async def go():
+        cluster, ui = await _cluster_with_ui()
+        try:
+            await asyncio.sleep(0.2)
+            st, h = await _http(ui.port, "GET", "/healthz")
+            assert st == 200 and h["status"] == "ok"
+
+            st, summary = await _http(ui.port, "GET", "/api/v1/cluster/summary")
+            assert st == 200 and summary["topologies"] == ["demo"]
+
+            st, topo = await _http(ui.port, "GET", "/api/v1/topology/demo")
+            assert st == 200
+            assert topo["status"] == "ACTIVE"
+            assert topo["components"]["echo"]["tasks"] == 2
+            assert topo["components"]["echo"]["alive"] == 2
+            assert topo["components"]["echo"]["executed"] > 0
+
+            st, met = await _http(ui.port, "GET", "/api/v1/topology/demo/metrics")
+            assert st == 200 and "echo" in met and "spout" in met
+
+            st, errs = await _http(ui.port, "GET", "/api/v1/topology/demo/errors")
+            assert st == 200 and errs["errors"] == []
+
+            st, _ = await _http(ui.port, "GET", "/api/v1/topology/nope")
+            assert st == 404
+            st, _ = await _http(ui.port, "GET", "/api/v1/bogus")
+            assert st == 404
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
+
+
+def test_ui_admin_actions(run):
+    async def go():
+        cluster, ui = await _cluster_with_ui()
+        try:
+            # deactivate stops the spout; status flips
+            st, r = await _http(ui.port, "POST", "/api/v1/topology/demo/deactivate")
+            assert st == 200 and r["status"] == "INACTIVE"
+            st, topo = await _http(ui.port, "GET", "/api/v1/topology/demo")
+            assert topo["status"] == "INACTIVE"
+            st, r = await _http(ui.port, "POST", "/api/v1/topology/demo/activate")
+            assert st == 200 and r["status"] == "ACTIVE"
+
+            # GET on an action is rejected
+            st, _ = await _http(ui.port, "GET", "/api/v1/topology/demo/activate")
+            assert st == 405
+
+            # live rebalance via the API
+            st, r = await _http(ui.port, "POST",
+                                "/api/v1/topology/demo/rebalance",
+                                body={"component": "echo", "parallelism": 4})
+            assert st == 200
+            rt = cluster.runtime("demo")
+            assert len(rt.bolt_execs["echo"]) == 4
+            st, _ = await _http(ui.port, "POST",
+                                "/api/v1/topology/demo/rebalance",
+                                body={"component": "nope", "parallelism": 2})
+            assert st == 404
+            st, _ = await _http(ui.port, "POST",
+                                "/api/v1/topology/demo/rebalance",
+                                body={"component": "echo"})
+            assert st == 400
+
+            # kill removes the topology (async; poll for it)
+            st, r = await _http(ui.port, "POST", "/api/v1/topology/demo/kill")
+            assert st == 200 and r["status"] == "KILLED"
+            for _ in range(100):
+                if "demo" not in cluster.runtimes:
+                    break
+                await asyncio.sleep(0.05)
+            assert "demo" not in cluster.runtimes
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
+
+
+def test_ui_malformed_requests(run):
+    async def go():
+        cluster, ui = await _cluster_with_ui()
+        try:
+            # garbage request line
+            reader, writer = await asyncio.open_connection("127.0.0.1", ui.port)
+            writer.write(b"NONSENSE\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            assert b"400" in raw.split(b"\r\n")[0]
+
+            # no body at all -> missing args -> 400
+            st, _ = await _http(ui.port, "POST", "/api/v1/topology/demo/rebalance",
+                                body=None)
+            assert st == 400
+
+            # literal non-JSON body -> the json.loads branch -> 400
+            reader, writer = await asyncio.open_connection("127.0.0.1", ui.port)
+            payload = b"this is { not json"
+            writer.write((
+                "POST /api/v1/topology/demo/rebalance HTTP/1.1\r\n"
+                "Host: localhost\r\n"
+                f"Content-Length: {len(payload)}\r\nConnection: close\r\n\r\n"
+            ).encode() + payload)
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            assert b" 400 " in raw.split(b"\r\n")[0] + b" "
+            assert b"not JSON" in raw
+
+            # negative Content-Length -> 400, not a 500 stack trace
+            reader, writer = await asyncio.open_connection("127.0.0.1", ui.port)
+            writer.write(
+                b"POST /api/v1/topology/demo/kill HTTP/1.1\r\n"
+                b"Host: localhost\r\nContent-Length: -1\r\n"
+                b"Connection: close\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            assert b"400" in raw.split(b"\r\n")[0]
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
+
+
+def test_ui_double_kill_is_noop(run):
+    async def go():
+        cluster, ui = await _cluster_with_ui()
+        try:
+            for _ in range(2):
+                st, r = await _http(ui.port, "POST", "/api/v1/topology/demo/kill")
+                assert st in (200, 404)
+            # second kill either 404s (already popped) or no-ops; daemon-style
+            # explicit kill afterwards must not raise either.
+            await cluster.kill("demo", wait_secs=0)
+            assert "demo" not in cluster.runtimes
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
