@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import Optional, Set
+from typing import Optional, Sequence, Set
 
 from storm_tpu.api.schema import DeadLetter, SchemaError, decode_instances, encode_predictions
 from storm_tpu.config import BatchConfig, Config, ModelConfig, ShardingConfig
@@ -44,20 +44,33 @@ class InferenceBolt(Bolt):
         sharding: Optional[ShardingConfig] = None,
         engine: Optional[InferenceEngine] = None,
         warmup: bool = True,
+        passthrough: Sequence[str] = (),
     ) -> None:
         self.model_cfg = model or ModelConfig()
         self.batch_cfg = batch or BatchConfig()
         self.sharding_cfg = sharding or ShardingConfig()
         self._engine = engine
         self._warmup = warmup
+        # Input fields copied verbatim onto every output tuple (both
+        # streams). How a DRPC request id rides through the operator —
+        # Storm's LinearDRPCTopologyBuilder threads return-info the same way.
+        self.passthrough = tuple(passthrough)
 
     def clone(self) -> "InferenceBolt":
         return InferenceBolt(
-            self.model_cfg, self.batch_cfg, self.sharding_cfg, self._engine, self._warmup
+            self.model_cfg, self.batch_cfg, self.sharding_cfg, self._engine,
+            self._warmup, self.passthrough
         )
 
     def declare_output_fields(self):
-        return {"default": ("message",), "dead_letter": ("message",)}
+        fields = ("message",) + self.passthrough
+        return {"default": fields, "dead_letter": fields}
+
+    def _extras(self, t: Tuple):
+        # Default-tolerant: a stream that doesn't carry a passthrough field
+        # (e.g. a Kafka spout sharing this bolt with a DRPC spout) yields
+        # None rather than poisoning the whole batch with a KeyError.
+        return [t.get(f, None) for f in self.passthrough]
 
     def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
         super().prepare(context, collector)
@@ -111,7 +124,8 @@ class InferenceBolt(Bolt):
         self._m_dead.inc()
         dl = DeadLetter(payload=str(payload), error=error)
         await self.collector.emit(
-            Values([dl.to_json()]), stream="dead_letter", anchors=[t]
+            Values([dl.to_json(), *self._extras(t)]),
+            stream="dead_letter", anchors=[t],
         )
         self.collector.ack(t)
 
@@ -149,7 +163,8 @@ class InferenceBolt(Bolt):
             self._m_infer.inc(batch.size)
             for tup, preds in batch.split(out):
                 await self.collector.emit(
-                    Values([encode_predictions(preds)]), anchors=[tup]
+                    Values([encode_predictions(preds), *self._extras(tup)]),
+                    anchors=[tup],
                 )
                 self.collector.ack(tup)
         except Exception as e:

@@ -82,15 +82,22 @@ class Tuple:
     def __len__(self) -> int:
         return len(self.values)
 
-    def get(self, name: str) -> Any:
+    _MISSING = object()
+
+    def get(self, name: str, default: Any = _MISSING) -> Any:
         """Field access by declared name (Storm's ``getValueByField``).
 
         O(1): the field->index map is cached per distinct fields tuple
         (fields objects are shared across every tuple of a stream), and
         this is on the per-tuple hot path (groupings, sink mapping).
+        A ``default`` makes missing fields non-fatal (Storm's ``contains``
+        + get in one call) — used by passthrough plumbing fed by streams
+        that don't declare the field.
         """
         idx = _field_index(tuple(self.fields)).get(name)
         if idx is None:
+            if default is not Tuple._MISSING:
+                return default
             raise KeyError(
                 f"no field {name!r} in stream from {self.source_component} "
                 f"(fields: {list(self.fields)})"

@@ -38,16 +38,18 @@ import logging
 
 log = logging.getLogger("storm_tpu.ui")
 
-_MAX_BODY = 1 << 20  # 1 MiB is far beyond any admin request
+_MAX_BODY = 32 << 20  # 32 MiB: sized for DRPC inference payloads, not just admin
 
 
 class UIServer:
     """Serve status/admin HTTP for the topologies in an AsyncLocalCluster."""
 
-    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0,
+                 drpc=None) -> None:
         self.cluster = cluster
         self.host = host
         self.port = port  # replaced by the bound port after start()
+        self.drpc = drpc  # optional DRPCServer: enables /api/v1/drpc/{fn}
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = time.monotonic()
         self._kill_tasks: set = set()
@@ -64,9 +66,10 @@ class UIServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
-        for task in list(self._kill_tasks):
-            if not task.done():
-                await task
+        if self._kill_tasks:
+            # Exceptions are logged by _kill_done; never let a failing kill
+            # abort the caller's shutdown sequence.
+            await asyncio.gather(*list(self._kill_tasks), return_exceptions=True)
 
     def _kill_done(self, task) -> None:
         self._kill_tasks.discard(task)
@@ -84,7 +87,9 @@ class UIServer:
             status, payload = 500, {"error": str(e)}
         body = json.dumps(payload, default=str).encode()
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  405: "Method Not Allowed", 500: "Internal Server Error"}
+                  405: "Method Not Allowed", 413: "Payload Too Large",
+                  500: "Internal Server Error", 502: "Bad Gateway",
+                  504: "Gateway Timeout"}
         head = (
             f"HTTP/1.1 {status} {reason.get(status, 'OK')}\r\n"
             f"Content-Type: application/json\r\n"
@@ -118,7 +123,9 @@ class UIServer:
                     return 400, {"error": "bad content-length"}
                 if content_length < 0:
                     return 400, {"error": "bad content-length"}
-                content_length = min(content_length, _MAX_BODY)
+                if content_length > _MAX_BODY:
+                    # explicit refusal beats silent truncation + bogus 400
+                    return 413, {"error": f"body exceeds {_MAX_BODY} bytes"}
         body: Dict[str, Any] = {}
         if content_length:
             raw = await reader.readexactly(content_length)
@@ -127,6 +134,8 @@ class UIServer:
                     body = json.loads(raw)
                 except ValueError:
                     return 400, {"error": "body is not JSON"}
+                if not isinstance(body, dict):
+                    return 400, {"error": "body must be a JSON object"}
         url = urlsplit(target)
         query = {k: v[-1] for k, v in parse_qs(url.query).items()}
         return await self._route(method, url.path.rstrip("/"), query, body)
@@ -142,6 +151,34 @@ class UIServer:
         if path == "/api/v1/topology/summary":
             return 200, {"topologies": [self._topo_summary(rt)
                                         for rt in self._runtimes().values()]}
+        if path.startswith("/api/v1/drpc/"):
+            if method != "POST":
+                return 405, {"error": "drpc is POST"}
+            if self.drpc is None:
+                return 404, {"error": "no DRPC server attached"}
+            function = path[len("/api/v1/drpc/"):]
+            args = body.get("args") if isinstance(body, dict) else None
+            if not function or not isinstance(args, str):
+                return 400, {"error": 'need function in path and {"args": "<str>"}'}
+            try:
+                timeout_s = float(query.get("timeout_s", 30.0))
+            except ValueError:
+                return 400, {"error": "timeout_s must be a number"}
+            from storm_tpu.runtime.drpc import (
+                DRPCError,
+                DRPCTimeout,
+                DRPCUnknownFunction,
+            )
+
+            try:
+                result = await self.drpc.execute(function, args, timeout_s)
+            except DRPCUnknownFunction as e:
+                return 404, {"error": str(e)}
+            except DRPCTimeout as e:
+                return 504, {"error": str(e)}
+            except DRPCError as e:
+                return 502, {"error": str(e)}
+            return 200, {"result": result}
         if path.startswith("/api/v1/topology/"):
             rest = path[len("/api/v1/topology/"):]
             name, _, action = rest.partition("/")

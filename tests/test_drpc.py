@@ -1,0 +1,194 @@
+"""DRPC (runtime/drpc.py): synchronous request/response through a topology —
+the storm-core capability (SURVEY.md §1 layer 1) plus the Kafka-free
+synchronous inference path built on InferenceBolt passthrough fields."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from storm_tpu.config import BatchConfig, Config, ModelConfig
+from storm_tpu.runtime import Bolt, TopologyBuilder, Values
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+from storm_tpu.runtime.drpc import (
+    DRPCError,
+    DRPCServer,
+    DRPCSpout,
+    DRPCTimeout,
+    DRPCUnknownFunction,
+    ReturnResultsBolt,
+    drpc_inference_topology,
+)
+
+
+class UpperBolt(Bolt):
+    """args -> ARGS, carrying request_id through (Storm's linear DRPC shape)."""
+
+    def declare_output_fields(self):
+        return {"default": ("message", "request_id")}
+
+    async def execute(self, t):
+        await self.collector.emit(
+            Values([t.get("message").upper(), t.get("request_id")]), anchors=[t]
+        )
+        self.collector.ack(t)
+
+
+class BoomBolt(Bolt):
+    async def execute(self, t):
+        raise RuntimeError("boom")
+
+
+class SwallowBolt(Bolt):
+    """Acks without ever emitting a result downstream."""
+
+    async def execute(self, t):
+        self.collector.ack(t)
+
+
+def _echo_topology(server, worker_cls=UpperBolt):
+    tb = TopologyBuilder()
+    tb.set_spout("drpc-spout", DRPCSpout(server, "upper"), parallelism=1)
+    tb.set_bolt("work", worker_cls(), parallelism=2).shuffle_grouping("drpc-spout")
+    tb.set_bolt("return", ReturnResultsBolt(server), parallelism=1)\
+        .shuffle_grouping("work")
+    return tb.build()
+
+
+def test_drpc_execute_roundtrip(run):
+    async def go():
+        server = DRPCServer()
+        cluster = AsyncLocalCluster()
+        await cluster.submit("drpc", Config(), _echo_topology(server))
+        try:
+            results = await asyncio.gather(
+                *(server.execute("upper", f"hello-{i}") for i in range(8))
+            )
+            assert results == [f"HELLO-{i}".upper() for i in range(8)]
+            assert server.inflight == 0
+        finally:
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
+
+
+def test_drpc_timeout(run):
+    async def go():
+        server = DRPCServer()
+        cluster = AsyncLocalCluster()
+        # registered function whose topology never returns a result
+        await cluster.submit("drpc", Config(), _echo_topology(server, SwallowBolt))
+        try:
+            with pytest.raises(DRPCTimeout):
+                await server.execute("upper", "x", timeout_s=0.3)
+            assert server.inflight == 0
+        finally:
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
+
+
+def test_drpc_unknown_function_rejected(run):
+    async def go():
+        server = DRPCServer()
+        cluster = AsyncLocalCluster()
+        await cluster.submit("drpc", Config(), _echo_topology(server))
+        try:
+            # unknown names are rejected immediately (no queue leak, no
+            # silent timeout) and nothing is left pending
+            with pytest.raises(DRPCUnknownFunction):
+                await server.execute("unknown-fn", "x", timeout_s=5.0)
+            assert server.inflight == 0
+            assert "unknown-fn" not in server._queues
+        finally:
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
+
+
+def test_drpc_failure_propagates(run):
+    async def go():
+        server = DRPCServer()
+        cfg = Config()
+        # fail fast: one failed delivery should error the call, not replay
+        cfg.topology.message_timeout_s = 1.0
+        cluster = AsyncLocalCluster()
+        await cluster.submit("drpc", cfg, _echo_topology(server, BoomBolt))
+        try:
+            with pytest.raises(DRPCError):
+                await server.execute("upper", "x", timeout_s=10.0)
+        finally:
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
+
+
+def test_drpc_inference_topology(run):
+    async def go():
+        server = DRPCServer()
+        topo = drpc_inference_topology(
+            server,
+            ModelConfig(name="lenet5", input_shape=(28, 28, 1)),
+            BatchConfig(max_batch=4, max_wait_ms=10, buckets=(4,)),
+            warmup=False,
+        )
+        cluster = AsyncLocalCluster()
+        await cluster.submit("serve", Config(), topo)
+        try:
+            rng = np.random.RandomState(0)
+            payload = json.dumps({"instances": rng.rand(1, 28, 28, 1).tolist()})
+            out = await server.execute("predict", payload, timeout_s=60)
+            preds = json.loads(out)["predictions"]
+            assert len(preds) == 1 and len(preds[0]) == 10
+            assert abs(sum(preds[0]) - 1.0) < 1e-3
+
+            # concurrent calls are micro-batched together
+            outs = await asyncio.gather(*(
+                server.execute(
+                    "predict",
+                    json.dumps({"instances": rng.rand(1, 28, 28, 1).tolist()}),
+                    timeout_s=60,
+                )
+                for _ in range(6)
+            ))
+            assert len(outs) == 6
+
+            # poison input -> DRPCError with the schema error, not a timeout
+            with pytest.raises(DRPCError) as ei:
+                await server.execute("predict", '{"instances": [[1,2],[3]]}',
+                                     timeout_s=60)
+            assert "timeout" not in str(ei.value).lower()
+        finally:
+            await cluster.shutdown()
+
+    run(go(), timeout=120)
+
+
+def test_drpc_over_http(run):
+    from storm_tpu.runtime.ui import UIServer
+    from tests.test_ui import _http
+
+    async def go():
+        server = DRPCServer()
+        cluster = AsyncLocalCluster()
+        await cluster.submit("drpc", Config(), _echo_topology(server))
+        ui = await UIServer(cluster, port=0, drpc=server).start()
+        try:
+            st, r = await _http(ui.port, "POST", "/api/v1/drpc/upper",
+                                body={"args": "hi there"})
+            assert st == 200 and r["result"] == "HI THERE"
+
+            st, r = await _http(ui.port, "POST", "/api/v1/drpc/unknown?timeout_s=0.3",
+                                body={"args": "x"})
+            assert st == 404  # unregistered function, immediate rejection
+
+            st, _ = await _http(ui.port, "POST", "/api/v1/drpc/upper", body={})
+            assert st == 400
+            st, _ = await _http(ui.port, "GET", "/api/v1/drpc/upper")
+            assert st == 405
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
