@@ -123,7 +123,9 @@ def _load_config(args) -> Config:
 
 async def _run_daemon(name: str, cfg: Config, duration: float,
                       autoscale_target_ms: float = 0.0,
-                      ui_port: int = -1) -> None:
+                      ui_port: int = -1,
+                      metrics_file: str = "",
+                      metrics_interval_s: float = 10.0) -> None:
     from storm_tpu.runtime.cluster import AsyncLocalCluster
 
     broker = _make_broker(cfg)
@@ -135,6 +137,11 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
         desc = cfg.model.name
     cluster = AsyncLocalCluster()
     rt = await cluster.submit(name, cfg, topo)
+    if metrics_file:
+        from storm_tpu.runtime.metrics import JsonLinesConsumer
+
+        rt.add_metrics_consumer(JsonLinesConsumer(metrics_file),
+                                interval_s=metrics_interval_s)
     scalers = []
     if autoscale_target_ms > 0:
         from storm_tpu.runtime.autoscale import Autoscaler, AutoscalePolicy
@@ -212,6 +219,10 @@ def main(argv=None) -> int:
     runp.add_argument("--ui-port", type=int, default=-1,
                       help="serve the Storm-UI-equivalent HTTP status/admin "
                            "API on this port (0 = ephemeral, -1 = off)")
+    runp.add_argument("--metrics-file", default="",
+                      help="append a JSON-lines metrics snapshot to this "
+                           "file every --metrics-interval seconds")
+    runp.add_argument("--metrics-interval", type=float, default=10.0)
 
     distp = sub.add_parser(
         "dist-run",
@@ -255,7 +266,8 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
         asyncio.run(_run_daemon(args.name, cfg, args.duration,
-                                args.autoscale_target_ms, args.ui_port))
+                                args.autoscale_target_ms, args.ui_port,
+                                args.metrics_file, args.metrics_interval))
         return 0
 
     if args.cmd == "dist-run":

@@ -77,6 +77,8 @@ class TopologyRuntime:
         self.errors: List[Tup[str, int, BaseException]] = []
         self._sweeper: Optional[asyncio.Task] = None
         self._error_cb: Optional[Callable] = None
+        self._consumer_tasks: List[asyncio.Task] = []
+        self._consumers: List[Any] = []
 
     # ---- wiring --------------------------------------------------------------
 
@@ -268,6 +270,24 @@ class TopologyRuntime:
             await asyncio.sleep(0.01)
         return False
 
+    # ---- metrics consumers (Storm's IMetricsConsumer, SURVEY.md §5.5) -------
+
+    def add_metrics_consumer(self, consumer, interval_s: float = 10.0) -> None:
+        """Publish a metrics snapshot to ``consumer.handle(topology, ts,
+        snapshot)`` every ``interval_s`` seconds until the topology dies
+        (Storm's ``Config.registerMetricsConsumer`` equivalent)."""
+        self._consumers.append(consumer)
+
+        async def pump() -> None:
+            while True:
+                await asyncio.sleep(interval_s)
+                try:
+                    consumer.handle(self.name, time.time(), self.metrics.snapshot())
+                except Exception:
+                    log.exception("metrics consumer %r failed", consumer)
+
+        self._consumer_tasks.append(asyncio.get_running_loop().create_task(pump()))
+
     async def kill(self, wait_secs: float = 0.0) -> None:
         """Kill the topology. ``wait_secs`` mirrors Storm's KillOptions
         (the reference sets wait_secs=0 for a hard kill,
@@ -275,6 +295,22 @@ class TopologyRuntime:
         if wait_secs > 0:
             await self.deactivate()
             await self.drain(timeout_s=wait_secs)
+        for task in self._consumer_tasks:
+            task.cancel()
+        for consumer in self._consumers:
+            # final snapshot so short-lived topologies still record once; a
+            # failing last handle() must not leak the consumer's resources
+            try:
+                consumer.handle(self.name, time.time(), self.metrics.snapshot())
+            except Exception:
+                log.exception("metrics consumer %r final handle failed", consumer)
+            finally:
+                try:
+                    consumer.close()
+                except Exception:
+                    log.exception("metrics consumer %r close failed", consumer)
+        self._consumer_tasks.clear()
+        self._consumers.clear()
         if self._sweeper:
             self._sweeper.cancel()
         for execs in self.spout_execs.values():

@@ -103,6 +103,14 @@ class DRPCServer:
         if fut is not None and not fut.done():
             fut.set_exception(DRPCError(error))
 
+    def fail_all(self, error: str) -> None:
+        """Fail every in-flight request (the serving topology died); call
+        when killing a topology so blocked callers error immediately instead
+        of waiting out their timeouts."""
+        for fut in list(self._pending.values()):
+            if not fut.done():
+                fut.set_exception(DRPCError(error))
+
     @property
     def inflight(self) -> int:
         return len(self._pending)

@@ -118,3 +118,98 @@ class MetricsRegistry:
         for (comp, name), h in list(self._histograms.items()):
             out.setdefault(comp, {})[name] = h.snapshot()
         return out
+
+
+# ---------------------------------------------------------------------------
+# Metrics consumers (Storm's IMetricsConsumer registration, SURVEY.md §5.5)
+# ---------------------------------------------------------------------------
+
+
+class MetricsConsumer:
+    """Receives periodic metric snapshots from a running topology.
+
+    Equivalent of Storm's ``IMetricsConsumer`` (registered via
+    ``Config.registerMetricsConsumer``); here consumers attach to the
+    :class:`~storm_tpu.runtime.cluster.TopologyRuntime` with
+    ``rt.add_metrics_consumer(consumer, interval_s)``.
+    """
+
+    def handle(self, topology: str, ts: float,
+               snapshot: Dict[str, Dict[str, object]]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class JsonLinesConsumer(MetricsConsumer):
+    """Appends one JSON line per interval to a file — the storm-perf-style
+    flight recorder the reference lacked (SURVEY.md §6)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._fh = open(path, "a", buffering=1)
+
+    def handle(self, topology: str, ts: float, snapshot) -> None:
+        import json
+
+        self._fh.write(json.dumps(
+            {"ts": ts, "topology": topology, "metrics": snapshot},
+            default=str) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class CallbackConsumer(MetricsConsumer):
+    """Adapter: any ``fn(topology, ts, snapshot)`` becomes a consumer."""
+
+    def __init__(self, fn) -> None:
+        self.fn = fn
+
+    def handle(self, topology: str, ts: float, snapshot) -> None:
+        self.fn(topology, ts, snapshot)
+
+
+def _prom_escape(v: str) -> str:
+    """Escape a label value per the exposition format (backslash, quote,
+    newline) — an arbitrary CLI topology name must not corrupt the scrape."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def prometheus_text(registries: Dict[str, "MetricsRegistry"]) -> str:
+    """Render ``{topology: MetricsRegistry}`` in Prometheus text exposition
+    format. Metric *kind* comes from the registry (not value types): counters
+    become ``storm_tpu_<name>_total``, gauges ``storm_tpu_<name>``, and
+    histograms a ``_count``/``_sum`` pair plus mean/p50/p95/p99 gauges —
+    enough for a stock Prometheus scrape of the UI server's ``/metrics``
+    (including ``rate(_sum)/rate(_count)`` averages).
+    """
+    lines = []
+
+    def sane(v) -> str:
+        try:
+            f = float(v)
+        except (TypeError, ValueError):
+            return "NaN"
+        return repr(f) if f == f else "NaN"
+
+    def name_of(metric: str, suffix: str = "") -> str:
+        safe = "".join(c if c.isalnum() else "_" for c in metric)
+        return f"storm_tpu_{safe}{suffix}"
+
+    for topo, reg in sorted(registries.items()):
+        for (comp, mname), c in sorted(reg._counters.items()):
+            labels = f'{{topology="{_prom_escape(topo)}",component="{_prom_escape(comp)}"}}'
+            lines.append(f"{name_of(mname, '_total')}{labels} {c.value}")
+        for (comp, mname), g in sorted(reg._gauges.items()):
+            labels = f'{{topology="{_prom_escape(topo)}",component="{_prom_escape(comp)}"}}'
+            lines.append(f"{name_of(mname)}{labels} {sane(g.value)}")
+        for (comp, mname), h in sorted(reg._histograms.items()):
+            labels = f'{{topology="{_prom_escape(topo)}",component="{_prom_escape(comp)}"}}'
+            lines.append(f"{name_of(mname, '_count')}{labels} {h.count}")
+            lines.append(f"{name_of(mname, '_sum')}{labels} {sane(h.sum)}")
+            snap = h.snapshot()
+            for q in ("mean", "p50", "p95", "p99"):
+                lines.append(f"{name_of(mname, '_' + q)}{labels} {sane(snap[q])}")
+    return "\n".join(lines) + "\n"

@@ -41,6 +41,10 @@ log = logging.getLogger("storm_tpu.ui")
 _MAX_BODY = 32 << 20  # 32 MiB: sized for DRPC inference payloads, not just admin
 
 
+class _PlainText(str):
+    """Marker: route result is already rendered text, not JSON."""
+
+
 class UIServer:
     """Serve status/admin HTTP for the topologies in an AsyncLocalCluster."""
 
@@ -85,14 +89,19 @@ class UIServer:
         except Exception as e:  # defense: a handler bug must not kill the loop
             log.exception("ui handler error")
             status, payload = 500, {"error": str(e)}
-        body = json.dumps(payload, default=str).encode()
+        if isinstance(payload, _PlainText):
+            body = str(payload).encode()
+            ctype = "text/plain; version=0.0.4"  # Prometheus exposition
+        else:
+            body = json.dumps(payload, default=str).encode()
+            ctype = "application/json"
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
                   405: "Method Not Allowed", 413: "Payload Too Large",
                   500: "Internal Server Error", 502: "Bad Gateway",
                   504: "Gateway Timeout"}
         head = (
             f"HTTP/1.1 {status} {reason.get(status, 'OK')}\r\n"
-            f"Content-Type: application/json\r\n"
+            f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(body)}\r\n"
             f"Connection: close\r\n\r\n"
         )
@@ -146,6 +155,14 @@ class UIServer:
                      body: Dict[str, Any]) -> Tuple[int, Any]:
         if path == "/healthz":
             return 200, {"status": "ok", "uptime_s": round(time.monotonic() - self._started, 3)}
+        if path == "/metrics":
+            # Prometheus text exposition over every live topology.
+            from storm_tpu.runtime.metrics import prometheus_text
+
+            text = prometheus_text(
+                {name: rt.metrics for name, rt in self._runtimes().items()}
+            )
+            return 200, _PlainText(text)
         if path == "/api/v1/cluster/summary":
             return 200, self._cluster_summary()
         if path == "/api/v1/topology/summary":
@@ -164,6 +181,10 @@ class UIServer:
                 timeout_s = float(query.get("timeout_s", 30.0))
             except ValueError:
                 return 400, {"error": "timeout_s must be a number"}
+            # finite + bounded: inf would park the handler forever and leak
+            # the pending future; cap keeps hung clients from pinning sockets
+            if not (0 < timeout_s <= 600):
+                return 400, {"error": "timeout_s must be in (0, 600]"}
             from storm_tpu.runtime.drpc import (
                 DRPCError,
                 DRPCTimeout,
@@ -278,5 +299,11 @@ class UIServer:
             )
             self._kill_tasks.add(task)
             task.add_done_callback(self._kill_done)
+            if self.drpc is not None:
+                # a dead topology can never answer: fail in-flight DRPC
+                # callers now instead of letting their timeouts burn
+                task.add_done_callback(
+                    lambda _t: self.drpc.fail_all("topology killed")
+                )
             return 200, {"status": "KILLED", "wait_secs": wait_secs}
         return 404, {"error": f"no action {action!r}"}

@@ -1,0 +1,133 @@
+"""Metrics consumers (Storm's IMetricsConsumer equivalent) and the
+Prometheus text endpoint (SURVEY.md §5.5 — first-class observability the
+reference delegated to Storm UI)."""
+
+import asyncio
+
+from storm_tpu.config import Config
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+from storm_tpu.runtime.metrics import (
+    CallbackConsumer,
+    JsonLinesConsumer,
+    MetricsRegistry,
+    prometheus_text,
+)
+from tests.test_ui import EchoBolt, TrickleSpout, _http
+
+
+def _topology():
+    from storm_tpu.runtime import TopologyBuilder
+
+    tb = TopologyBuilder()
+    tb.set_spout("spout", TrickleSpout(), parallelism=1)
+    tb.set_bolt("echo", EchoBolt(), parallelism=2).shuffle_grouping("spout")
+    return tb.build()
+
+
+def test_metrics_consumer_receives_snapshots(run):
+    async def go():
+        got = []
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("m", Config(), _topology())
+        rt.add_metrics_consumer(
+            CallbackConsumer(lambda topo, ts, snap: got.append((topo, snap))),
+            interval_s=0.1,
+        )
+        await asyncio.sleep(0.5)
+        await cluster.shutdown()
+        assert len(got) >= 2  # periodic + final-on-kill
+        topo, snap = got[-1]
+        assert topo == "m"
+        assert snap["echo"]["executed"] > 0
+
+    run(go(), timeout=60)
+
+
+def test_jsonlines_consumer_writes_file(run, tmp_path):
+    async def go():
+        path = str(tmp_path / "metrics.jsonl")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("m", Config(), _topology())
+        rt.add_metrics_consumer(JsonLinesConsumer(path), interval_s=0.1)
+        await asyncio.sleep(0.35)
+        await cluster.shutdown()
+        import json
+
+        lines = [json.loads(l) for l in open(path)]
+        assert len(lines) >= 2
+        assert lines[-1]["topology"] == "m"
+        assert "echo" in lines[-1]["metrics"]
+
+    run(go(), timeout=60)
+
+
+def test_failing_consumer_does_not_kill_topology(run):
+    async def go():
+        def boom(topo, ts, snap):
+            raise RuntimeError("consumer bug")
+
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("m", Config(), _topology())
+        rt.add_metrics_consumer(CallbackConsumer(boom), interval_s=0.05)
+        await asyncio.sleep(0.3)
+        # topology still alive and processing despite the consumer blowing up
+        assert rt.metrics.snapshot()["echo"]["executed"] > 0
+        await cluster.shutdown()
+
+    run(go(), timeout=60)
+
+
+def test_prometheus_text_rendering():
+    reg = MetricsRegistry()
+    reg.counter("bolt", "executed").inc(5)
+    reg.gauge("bolt", "queue_depth").set(3.5)
+    reg.histogram("sink", "e2e_latency_ms").observe(12.0)
+    text = prometheus_text({"demo": reg})
+    assert 'storm_tpu_executed_total{topology="demo",component="bolt"} 5' in text
+    assert 'storm_tpu_queue_depth{topology="demo",component="bolt"} 3.5' in text
+    assert 'storm_tpu_e2e_latency_ms_count{topology="demo",component="sink"} 1' in text
+    assert 'storm_tpu_e2e_latency_ms_sum{topology="demo",component="sink"} 12.0' in text
+    assert 'storm_tpu_e2e_latency_ms_p50{topology="demo",component="sink"} 12.0' in text
+
+
+def test_prometheus_gauge_kind_stable_for_int_values():
+    # kind comes from the registry, not the value's Python type: an
+    # integer-valued gauge must NOT flip to a _total counter series
+    reg = MetricsRegistry()
+    reg.gauge("bolt", "queue_depth").set(3)
+    text = prometheus_text({"demo": reg})
+    assert 'storm_tpu_queue_depth{topology="demo",component="bolt"} 3.0' in text
+    assert "queue_depth_total" not in text
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter('we"ird', "executed").inc(1)
+    text = prometheus_text({'topo"1\\x': reg})
+    assert 'component="we\\"ird"' in text
+    assert 'topology="topo\\"1\\\\x"' in text
+
+
+def test_prometheus_endpoint(run):
+    async def go():
+        from storm_tpu.runtime.ui import UIServer
+
+        cluster = AsyncLocalCluster()
+        await cluster.submit("m", Config(), _topology())
+        ui = await UIServer(cluster, port=0).start()
+        try:
+            await asyncio.sleep(0.2)
+            reader, writer = await asyncio.open_connection("127.0.0.1", ui.port)
+            writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            head, _, body = raw.partition(b"\r\n\r\n")
+            assert b"200" in head.split(b"\r\n")[0]
+            assert b"text/plain" in head
+            assert b'storm_tpu_executed_total{topology="m",component="echo"}' in body
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
