@@ -94,6 +94,10 @@ class BoltExecutor:
             state = KeyValueState()
         self._state = state
         self.bolt.init_state(state)
+        # Synchronous-checkpoint hook: transactional bolts persist state
+        # BEFORE acking so an offset commit can never outrun the snapshot
+        # it depends on (exactly-once across crashes).
+        self.bolt.checkpoint_now = self._checkpoint
 
     def _checkpoint(self) -> None:
         if not self._state.dirty:

@@ -155,3 +155,8 @@ class StatefulBolt(Bolt):
     def pre_checkpoint(self) -> None:
         """Hook: flush in-flight aggregates into ``self.state`` before the
         snapshot is taken."""
+
+    def checkpoint_now(self) -> None:
+        """Force an immediate state snapshot. Bound to the executor's
+        checkpoint when running inside a topology; a no-op for bolts driven
+        standalone (tests). Transactional bolts call this before acking."""

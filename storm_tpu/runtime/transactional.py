@@ -1,0 +1,357 @@
+"""Exactly-once micro-batch processing — the Trident equivalent.
+
+Storm ships Trident in storm-core (the layer the reference inherits,
+SURVEY.md §1 layer 1): streams are processed as ordered, numbered
+micro-batches (txids); state writes record the txid so a replayed batch is
+applied exactly once. This module is the asyncio/TPU-native equivalent,
+built on the framework's existing at-least-once ledger + stateful bolts:
+
+- :class:`TransactionalSpout` — pulls records from a broker into numbered
+  batches, honoring the Trident *transactional spout* contract: a given
+  txid always contains exactly the same records. Batch offset ranges are
+  persisted (a second consumer-group namespace) BEFORE the batch is first
+  emitted, so even a coordinator restart re-forms the identical batch;
+  txids derive from committed offsets, so they stay strictly increasing
+  across restarts (an in-memory counter would reset and corrupt the
+  ``txid >=`` replay checks downstream).
+- :class:`TransactionalState` — per-key ``(txid, value)`` cells over
+  :class:`~storm_tpu.runtime.state.KeyValueState`; ``apply`` is a no-op
+  for txids at or below the stored one, so replayed batches cannot
+  double-update (Trident's "transactional state").
+- :class:`OpaqueState` — Trident's opaque variant (``txid, value, prev``):
+  re-applies over ``prev`` when the *same* txid arrives again, tolerating
+  sources that cannot guarantee identical replay content.
+- :class:`TransactionalBolt` — processes one batch per tuple via
+  ``process_batch(txid, records, state)``.
+- :class:`TransactionalSink` — idempotent egress: remembers the last txid
+  produced and skips replayed batches. (The crash window between produce
+  and state checkpoint is the one Kafka closes with broker-side
+  transactions; here the guarantee is effectively-once and documented,
+  not silently over-claimed.)
+
+One batch is in flight at a time (Trident pipelines processing but
+serializes commits; with a single in-flight batch the two coincide), so
+commits are trivially in txid order. End-to-end: at-least-once delivery +
+txid-idempotent state and egress = exactly-once effects.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Callable, Dict, List, Sequence, Tuple as Tup
+
+from storm_tpu.runtime.base import OutputCollector, Spout, TopologyContext
+from storm_tpu.runtime.state import KeyValueState, StatefulBolt
+from storm_tpu.runtime.tuples import Tuple, Values
+
+
+class TransactionalSpout(Spout):
+    """Numbered, immutable micro-batches from a broker topic.
+
+    Single coordinator: only task 0 emits (Trident's batch coordinator is
+    one instance); extra tasks idle.
+
+    The txid is the sum of ALL partitions' post-batch cursors — strictly
+    increasing batch to batch (each batch advances at least one cursor),
+    identical when a batch is re-formed from persisted pending ranges, and
+    monotonic across restarts.
+    """
+
+    def __init__(self, broker, topic: str, batch_size: int = 100,
+                 group: str = "tx") -> None:
+        self.broker = broker
+        self.topic = topic
+        self.batch_size = batch_size
+        self.group = group
+
+    def clone(self) -> "TransactionalSpout":
+        return TransactionalSpout(self.broker, self.topic, self.batch_size,
+                                  self.group)
+
+    def declare_output_fields(self):
+        return {"default": ("batch", "txid")}
+
+    @property
+    def _pending_group(self) -> str:
+        return self.group + ".pending"
+
+    # Blocking brokers (network clients) are called off-loop; commit_many is
+    # emulated with per-partition commits where the adapter lacks it (the
+    # partial-commit window is safe here: state is checkpointed before ack,
+    # so a half-committed batch re-forms as the same txid with the already-
+    # applied subset, which the txid cells skip and the re-ack completes).
+    def _commit_sync(self, group: str, offsets: Dict[int, int]) -> None:
+        commit_many = getattr(self.broker, "commit_many", None)
+        if commit_many is not None:
+            commit_many(group, self.topic, offsets)
+        else:
+            for p, off in offsets.items():
+                self.broker.commit(group, self.topic, p, off)
+
+    async def _call(self, fn, *args, **kw):
+        if getattr(self.broker, "blocking", False):
+            return await asyncio.to_thread(fn, *args, **kw)
+        return fn(*args, **kw)
+
+    def open(self, context: TopologyContext, collector: OutputCollector) -> None:
+        super().open(context, collector)
+        self._coordinator = context.task_index == 0
+        self._inflight: Dict[int, Dict[int, Tup[int, int]]] = {}  # txid -> {part: (start, end)}
+        self._replays: List[int] = []
+        self._cursor: Dict[int, int] = {}
+        self._to_commit: "Dict[int, int] | None" = None
+        if not self._coordinator:
+            return
+        n = self.broker.partitions_for(self.topic)
+        bases: Dict[int, int] = {}
+        pend_ranges: Dict[int, Tup[int, int]] = {}
+        for p in range(n):
+            committed = self.broker.committed(self.group, self.topic, p)
+            base = (committed if committed is not None
+                    else self.broker.earliest_offset(self.topic, p))
+            bases[p] = base
+            pend = self.broker.committed(self._pending_group, self.topic, p)
+            if pend is not None and pend > base:
+                pend_ranges[p] = (base, pend)
+        self._cursor = dict(bases)
+        if pend_ranges:
+            # Crash recovery: a batch was planned (ranges persisted) but
+            # never fully committed. Re-form the IDENTICAL batch — same
+            # ranges, same txid — and replay it first.
+            for p, (_s, end) in pend_ranges.items():
+                self._cursor[p] = end
+            txid = sum(self._cursor.values())
+            self._inflight[txid] = pend_ranges
+            self._replays.append(txid)
+
+    # ---- batch assembly ------------------------------------------------------
+
+    def _fetch_range(self, ranges: Dict[int, Tup[int, int]]) -> List[str]:
+        records: List[str] = []
+        for p, (start, end) in sorted(ranges.items()):
+            for r in self.broker.fetch(self.topic, p, start, max_records=end - start):
+                v = r.value
+                records.append(v.decode("utf-8") if isinstance(v, bytes) else v)
+        return records
+
+    async def next_tuple(self) -> bool:
+        if not self._coordinator:
+            return False
+        if self._to_commit:
+            # acks defer their offset commit here: ack() is sync, network
+            # brokers are not, and commits must precede the next batch
+            offsets, self._to_commit = self._to_commit, None
+            await self._call(self._commit_sync, self.group, offsets)
+        if self._replays:
+            txid = self._replays.pop(0)
+            ranges = self._inflight[txid]
+            records = await self._call(self._fetch_range, ranges)
+            await self.collector.emit(Values([records, txid]), msg_id=txid)
+            return True
+        if self._inflight:
+            return False  # single batch in flight: commits stay ordered
+        ranges: Dict[int, Tup[int, int]] = {}
+        records: List[str] = []
+        budget = self.batch_size
+
+        def plan() -> None:
+            nonlocal budget
+            for p in sorted(self._cursor):
+                if budget <= 0:
+                    break
+                start = self._cursor[p]
+                got = self.broker.fetch(self.topic, p, start, max_records=budget)
+                if got:
+                    ranges[p] = (start, start + len(got))
+                    budget -= len(got)
+                    for r in got:
+                        v = r.value
+                        records.append(
+                            v.decode("utf-8") if isinstance(v, bytes) else v
+                        )
+
+        await self._call(plan)
+        if not ranges:
+            return False
+        # Persist the planned ranges BEFORE first emit: a coordinator crash
+        # mid-batch must re-form this exact batch, not a different one that
+        # could overlap already-applied state updates (Trident persists its
+        # coordinator metadata for the same reason).
+        await self._call(
+            self._commit_sync, self._pending_group,
+            {p: end for p, (_s, end) in ranges.items()},
+        )
+        for p, (_s, end) in ranges.items():
+            self._cursor[p] = end
+        txid = sum(self._cursor.values())
+        self._inflight[txid] = ranges
+        await self.collector.emit(Values([records, txid]), msg_id=txid)
+        return True
+
+    # ---- completion ----------------------------------------------------------
+
+    def ack(self, msg_id: Any) -> None:
+        ranges = self._inflight.pop(msg_id, None)
+        if ranges is None:
+            return
+        # Deferred to next_tuple (async context): with one batch in flight
+        # the queue depth is <=1 and the commit always lands before the next
+        # batch forms. A crash before the flush replays the batch, whose
+        # effects are already checkpointed -> txid cells skip, re-ack
+        # completes the commit.
+        self._to_commit = {p: end for p, (_s, end) in ranges.items()}
+
+    def fail(self, msg_id: Any) -> None:
+        if msg_id in self._inflight and msg_id not in self._replays:
+            self._replays.append(msg_id)
+
+
+def _require_single_task(context: TopologyContext) -> None:
+    """txid dedup state is per-task; with shuffle grouping and >1 task a
+    replayed txid can land on a task that never saw it — double-apply.
+    Batches are one tuple anyway, so extra tasks buy nothing: refuse."""
+    if context.parallelism != 1:
+        raise ValueError(
+            f"{context.component_id}: transactional bolts/sinks require "
+            f"parallelism=1 (got {context.parallelism}); txid replay dedup "
+            "is per-task state"
+        )
+
+
+class TransactionalState:
+    """Per-key ``{"txid": t, "v": value}`` cells: exactly-once updates under
+    replay, provided a replayed txid carries identical records (the
+    transactional spout contract) and commits are in txid order."""
+
+    def __init__(self, kv: KeyValueState) -> None:
+        self.kv = kv
+
+    def apply(self, key: str, txid: int, fn: Callable[[Any], Any],
+              init: Any = None) -> Any:
+        """Set ``key`` to ``fn(previous)`` for this txid; replayed txids
+        return the stored value untouched."""
+        cell = self.kv.get(key)
+        if cell is not None and cell["txid"] >= txid:
+            return cell["v"]  # replay: already applied
+        value = fn(cell["v"] if cell is not None else init)
+        self.kv.put(key, {"txid": txid, "v": value})
+        return value
+
+    def value(self, key: str, default: Any = None) -> Any:
+        cell = self.kv.get(key)
+        return default if cell is None else cell["v"]
+
+    def items(self):
+        for k, cell in self.kv.items():
+            yield k, cell["v"]
+
+
+class OpaqueState(TransactionalState):
+    """Trident's opaque-transactional state: cells are
+    ``{"txid": t, "v": value, "prev": value_before_t}``.
+
+    When the SAME txid is applied again, the update is recomputed over
+    ``prev`` instead of skipped — correct even if that txid's batch content
+    changed (a source that can't replay identical batches). Still requires
+    in-order commits."""
+
+    def apply(self, key: str, txid: int, fn: Callable[[Any], Any],
+              init: Any = None) -> Any:
+        cell = self.kv.get(key)
+        if cell is None:
+            value = fn(init)
+            self.kv.put(key, {"txid": txid, "v": value, "prev": init})
+            return value
+        if cell["txid"] == txid:
+            value = fn(cell["prev"])  # same batch again: redo over prev
+            self.kv.put(key, {"txid": txid, "v": value, "prev": cell["prev"]})
+            return value
+        if cell["txid"] > txid:
+            return cell["v"]  # older replay: already folded in
+        value = fn(cell["v"])
+        self.kv.put(key, {"txid": txid, "v": value, "prev": cell["v"]})
+        return value
+
+
+class TransactionalBolt(StatefulBolt):
+    """One batch per tuple; subclasses implement ``process_batch``.
+
+    ``process_batch`` returns the batch's output *messages*; they are
+    emitted downstream as ONE ``(batch, txid)`` tuple — the batch stays
+    atomic through the topology, which is what lets the txid-keyed sink
+    dedup replays (per-record emits sharing a txid would make the second
+    record of a batch look like a replay of the first). Anchored to the
+    input tuple, so a downstream failure fails and replays the whole
+    batch; state updates (through :class:`TransactionalState`) still
+    apply exactly once. Set ``opaque = True`` for :class:`OpaqueState`
+    semantics."""
+
+    opaque = False
+
+    def declare_output_fields(self):
+        return {"default": ("batch", "txid")}
+
+    def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
+        super().prepare(context, collector)
+        _require_single_task(context)
+
+    def init_state(self, state: KeyValueState) -> None:
+        super().init_state(state)
+        self.tx_state = (OpaqueState if self.opaque else TransactionalState)(state)
+
+    async def process_batch(self, txid: int, records: Sequence[str],
+                            state: TransactionalState) -> List[Any]:
+        raise NotImplementedError
+
+    async def execute(self, t: Tuple) -> None:
+        txid = t.get("txid")
+        outs = await self.process_batch(txid, t.get("batch"), self.tx_state)
+        if outs:
+            await self.collector.emit(Values([list(outs), txid]), anchors=[t])
+        # Persist BEFORE ack: the ack chain ends in an offset commit, and a
+        # committed batch must never be replayable while its state updates
+        # sit only in memory (crash between ack and the periodic snapshot).
+        self.checkpoint_now()
+        self.collector.ack(t)
+
+
+class TransactionalSink(StatefulBolt):
+    """Idempotent egress: produce each batch's output once, keyed by txid.
+
+    Expects tuples with fields ``(message, txid)`` (or ``(batch, txid)``
+    with a list payload). Skips txids at or below the last produced one —
+    the replayed half of a failed tuple tree does not duplicate output."""
+
+    def __init__(self, broker, topic: str) -> None:
+        self.broker = broker
+        self.topic = topic
+
+    def clone(self) -> "TransactionalSink":
+        return TransactionalSink(self.broker, self.topic)
+
+    def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
+        super().prepare(context, collector)
+        _require_single_task(context)
+
+    async def execute(self, t: Tuple) -> None:
+        txid = t.get("txid", None)
+        last = self.state.get("last_txid", -1)
+        if txid is not None and txid <= last:
+            self.collector.ack(t)  # replay: output already produced
+            return
+        payload = t.get("batch", None)
+        messages = payload if payload is not None else [t.get("message")]
+        produce = self.broker.produce
+        if getattr(self.broker, "blocking", False):
+            for m in messages:
+                value = m if isinstance(m, (str, bytes)) else json.dumps(m)
+                await asyncio.to_thread(produce, self.topic, value)
+        else:
+            for m in messages:
+                value = m if isinstance(m, (str, bytes)) else json.dumps(m)
+                produce(self.topic, value)
+        if txid is not None:
+            self.state.put("last_txid", txid)
+        self.checkpoint_now()
+        self.collector.ack(t)

@@ -61,6 +61,7 @@ class WindowedBolt(Bolt):
         self._count_mode = count_mode
         self._buf: Deque[Tup[Tuple, float]] = deque()
         self._since_fire = 0
+        self._last_fire = time.monotonic()
 
     # ---- user surface --------------------------------------------------------
 
@@ -89,10 +90,20 @@ class WindowedBolt(Bolt):
             keep = 0 if final else max(0, self.window_count - self.slide_count)
         else:
             now = time.monotonic()
-            window = [t for t, ts in self._buf if now - ts <= self.window_s]
+            # A tuple the previous fire never saw (ts > _last_fire) is
+            # included even if it has aged past window_s: when a tick
+            # arrives late (event-loop stall), the late window must still
+            # carry the stall's tuples — excluding them would leave them
+            # buffered forever, unacked, until the ledger timeout fails
+            # the whole tree.
+            window = [
+                t for t, ts in self._buf
+                if now - ts <= self.window_s or ts > self._last_fire
+            ]
             keep = 0 if final else sum(
                 1 for _, ts in self._buf if now - ts <= self.window_s - self.slide_s
             )
+            self._last_fire = now
         if not window:
             return
         try:

@@ -140,3 +140,46 @@ def test_tumbling_alias():
     assert b.window_count == 8 and b.slide_count == 8
     b2 = TumblingWindowBolt(duration_s=1.5)
     assert b2.window_s == b2.slide_s == 1.5
+
+
+def test_late_tick_still_windows_stalled_tuples(run):
+    """Event-loop stall regression: tuples older than window_s at the first
+    fire must ride the late window and be acked — not linger unacked until
+    the ledger times the tree out."""
+
+    class _Coll:
+        def __init__(self):
+            self.acked = []
+
+        def ack(self, t):
+            self.acked.append(t)
+
+        def fail(self, t):
+            pass
+
+        def report_error(self, e):
+            raise e
+
+    async def go():
+        import time as _time
+
+        CollectWindows.windows = []
+        bolt = CollectWindows(window_s=0.2, slide_s=0.1)
+        bolt.collector = _Coll()
+        from storm_tpu.runtime.tuples import Tuple as T
+
+        tups = [T(values=[f"x{i}"], fields=("message",),
+                  source_component="s", source_task=0) for i in range(3)]
+        for t in tups:
+            await bolt.execute(t)
+        # simulate a stall: age every buffered tuple far past window_s,
+        # keeping the last-fire mark before them (no fire saw them yet)
+        bolt._buf = type(bolt._buf)(
+            (t, ts - 10.0) for t, ts in bolt._buf
+        )
+        bolt._last_fire -= 20.0
+        await bolt.tick()
+        assert len(bolt.collector.acked) == 3
+        assert [m for w in CollectWindows.windows for m in w] == ["x0", "x1", "x2"]
+
+    run(go(), timeout=10)
