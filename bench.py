@@ -176,7 +176,7 @@ def run_multi(args) -> None:
     print(json.dumps(result))
 
 
-def build_topology(cfg, broker, batch_cfg, transfer_dtype=None):
+def build_topology(cfg, broker, batch_cfg, transfer_dtype=None, chunk=0):
     from storm_tpu.config import Config, ModelConfig, OffsetsConfig, ShardingConfig
     from storm_tpu.connectors import BrokerSink, BrokerSpout
     from storm_tpu.infer import InferenceBolt
@@ -195,7 +195,7 @@ def build_topology(cfg, broker, batch_cfg, transfer_dtype=None):
     tb.set_spout(
         "kafka-spout",
         BrokerSpout(broker, "input", OffsetsConfig(policy="earliest", max_behind=None),
-                    fetch_size=1024),
+                    fetch_size=1024, chunk=chunk),
         parallelism=2,
     )
     tb.set_bolt(
@@ -276,6 +276,11 @@ def main() -> None:
     ap.add_argument("--transfer-dtype", default=None, choices=["uint8"],
                     help="quantize the host->device wire to uint8 (4x fewer "
                          "bytes than f32 over the link; lossy, opt-in)")
+    ap.add_argument("--chunk", type=int, default=1,
+                    help="spout chunking: records per emitted tuple (1 = "
+                         "per-record tuples, the reference's granularity; "
+                         "N>1 cuts ledger/executor overhead for small "
+                         "payloads at chunk-replay granularity)")
     ap.add_argument("--skip-latency", action="store_true")
     args = ap.parse_args()
     if args.config == "multi":
@@ -301,7 +306,7 @@ def main() -> None:
         buckets=cfg["buckets"],
     )
     broker = MemoryBroker(default_partitions=4)
-    run_cfg, topo = build_topology(cfg, broker, batch_cfg, args.transfer_dtype)
+    run_cfg, topo = build_topology(cfg, broker, batch_cfg, args.transfer_dtype, args.chunk)
     t0 = time.time()
     cluster.submit_topology("bench-throughput", run_cfg, topo)
     log(f"submitted + warmed up in {time.time() - t0:.1f}s")
@@ -337,7 +342,7 @@ def main() -> None:
             buckets=cfg["buckets"],
         )
         broker2 = MemoryBroker(default_partitions=4)
-        run_cfg2, topo2 = build_topology(cfg, broker2, lat_batch_cfg, args.transfer_dtype)
+        run_cfg2, topo2 = build_topology(cfg, broker2, lat_batch_cfg, args.transfer_dtype, args.chunk)
         cluster.submit_topology("bench-latency", run_cfg2, topo2)
         # Offer well below saturation: the latency topology uses the short
         # deadline (small batches), so its capacity is below the

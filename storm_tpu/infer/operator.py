@@ -36,6 +36,27 @@ from storm_tpu.runtime.tracing import span
 from storm_tpu.runtime.tuples import Tuple, Values
 
 
+class _ChunkHandle:
+    """Ref-counted completion for a chunked input tuple (BrokerSpout
+    ``chunk=N``): N records share one upstream tuple; it is acked when every
+    record completes, failed (once) if any record's batch fails. Poison
+    records dead-letter individually and count as completed — one bad record
+    must not replay the whole chunk forever."""
+
+    __slots__ = ("tuple", "remaining", "failed")
+
+    def __init__(self, t: Tuple, n: int) -> None:
+        self.tuple = t
+        self.remaining = n
+        self.failed = False
+
+    def done(self, ok: bool, collector: OutputCollector) -> None:
+        self.failed |= not ok
+        self.remaining -= 1
+        if self.remaining == 0:
+            (collector.fail if self.failed else collector.ack)(self.tuple)
+
+
 class InferenceBolt(Bolt):
     def __init__(
         self,
@@ -96,37 +117,83 @@ class InferenceBolt(Bolt):
 
     # ---- ingest --------------------------------------------------------------
 
+    # Batch items are either a raw Tuple (one record per tuple) or a
+    # _ChunkHandle (chunked ingestion). These two helpers are the only
+    # places that distinguish them.
+
+    @staticmethod
+    def _anchor_of(item) -> Tuple:
+        return item.tuple if isinstance(item, _ChunkHandle) else item
+
+    def _complete(self, item, ok: bool) -> None:
+        if isinstance(item, _ChunkHandle):
+            item.done(ok, self.collector)
+        elif ok:
+            self.collector.ack(item)
+        else:
+            self.collector.fail(item)
+
+    def _decode_checked(self, payload, root_ts):
+        """Decode + shape-validate one record (raises SchemaError)."""
+        with span(self.context.metrics, self.context.component_id, "decode"):
+            inst = decode_instances(payload, ts=root_ts)
+        if tuple(inst.data.shape[1:]) != self.engine.input_shape:
+            raise SchemaError(
+                f"instance shape {tuple(inst.data.shape[1:])} != model "
+                f"input {self.engine.input_shape}"
+            )
+        return inst
+
+    async def _emit_dead_letter(self, anchor: Tuple, payload, error: str) -> None:
+        self._m_dead.inc()
+        dl = DeadLetter(payload=str(payload), error=error)
+        await self.collector.emit(
+            Values([dl.to_json(), *self._extras(anchor)]),
+            stream="dead_letter", anchors=[anchor],
+        )
+
+    def _kick_flush(self) -> None:
+        if len(self.batcher) and (self._flush_task is None or self._flush_task.done()):
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._deadline_flush()
+            )
+
     async def execute(self, t: Tuple) -> None:
         payload = t.get("message")
+        if isinstance(payload, (list, tuple)):
+            await self._execute_chunk(t, payload)
+            return
         try:
-            with span(self.context.metrics, self.context.component_id, "decode"):
-                inst = decode_instances(payload, ts=t.root_ts)
-            if tuple(inst.data.shape[1:]) != self.engine.input_shape:
-                raise SchemaError(
-                    f"instance shape {tuple(inst.data.shape[1:])} != model "
-                    f"input {self.engine.input_shape}"
-                )
+            inst = self._decode_checked(payload, t.root_ts)
         except SchemaError as e:
             await self._dead_letter(t, payload, str(e))
             return
         batch = self.batcher.add(t, inst.data, ts=t.root_ts or None)
         if batch is not None:
             await self._dispatch(batch)
-        if len(self.batcher) and (self._flush_task is None or self._flush_task.done()):
-            self._flush_task = asyncio.get_running_loop().create_task(
-                self._deadline_flush()
-            )
+        self._kick_flush()
+
+    async def _execute_chunk(self, t: Tuple, payloads) -> None:
+        handle = _ChunkHandle(t, len(payloads))
+        for payload in payloads:
+            try:
+                inst = self._decode_checked(payload, t.root_ts)
+            except SchemaError as e:
+                # Dead-letter the record, keep the chunk alive: anchored to
+                # the chunk tuple, completed as handled.
+                await self._emit_dead_letter(t, payload, str(e))
+                handle.done(True, self.collector)
+                continue
+            batch = self.batcher.add(handle, inst.data, ts=t.root_ts or None)
+            if batch is not None:
+                await self._dispatch(batch)
+        self._kick_flush()
 
     async def _dead_letter(self, t: Tuple, payload: str, error: str) -> None:
         """Poison input: route to the dead-letter stream and ack (replaying
         a parse failure can never succeed; the reference's emit-null-and-ack
         at InferenceBolt.java:92-99 is the anti-pattern this replaces)."""
-        self._m_dead.inc()
-        dl = DeadLetter(payload=str(payload), error=error)
-        await self.collector.emit(
-            Values([dl.to_json(), *self._extras(t)]),
-            stream="dead_letter", anchors=[t],
-        )
+        await self._emit_dead_letter(t, payload, error)
         self.collector.ack(t)
 
     # ---- batching / dispatch -------------------------------------------------
@@ -161,17 +228,18 @@ class InferenceBolt(Bolt):
             self._m_device_ms.observe((time.perf_counter() - t0) * 1e3)
             self._m_batch.observe(batch.size)
             self._m_infer.inc(batch.size)
-            for tup, preds in batch.split(out):
+            for item, preds in batch.split(out):
+                anchor = self._anchor_of(item)
                 await self.collector.emit(
-                    Values([encode_predictions(preds), *self._extras(tup)]),
-                    anchors=[tup],
+                    Values([encode_predictions(preds), *self._extras(anchor)]),
+                    anchors=[anchor],
                 )
-                self.collector.ack(tup)
+                self._complete(item, True)
         except Exception as e:
             # Device/compile failure: fail every tuple in the batch -> replay.
             self.collector.report_error(e)
             for item in batch.items:
-                self.collector.fail(item.payload)
+                self._complete(item.payload, False)
         finally:
             self._dispatch_sem.release()
 

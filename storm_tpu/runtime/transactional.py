@@ -132,7 +132,7 @@ class TransactionalSpout(Spout):
         for p, (start, end) in sorted(ranges.items()):
             for r in self.broker.fetch(self.topic, p, start, max_records=end - start):
                 v = r.value
-                records.append(v.decode("utf-8") if isinstance(v, bytes) else v)
+                records.append(v.decode("utf-8", "replace") if isinstance(v, bytes) else v)
         return records
 
     async def next_tuple(self) -> bool:
@@ -167,8 +167,10 @@ class TransactionalSpout(Spout):
                     budget -= len(got)
                     for r in got:
                         v = r.value
+                        # errors="replace", like BrokerSpout: one undecodable
+                        # record must not stall the coordinator forever
                         records.append(
-                            v.decode("utf-8") if isinstance(v, bytes) else v
+                            v.decode("utf-8", "replace") if isinstance(v, bytes) else v
                         )
 
         await self._call(plan)
@@ -342,14 +344,14 @@ class TransactionalSink(StatefulBolt):
             return
         payload = t.get("batch", None)
         messages = payload if payload is not None else [t.get("message")]
+        values = [m if isinstance(m, (str, bytes)) else json.dumps(m)
+                  for m in messages]
         produce = self.broker.produce
         if getattr(self.broker, "blocking", False):
-            for m in messages:
-                value = m if isinstance(m, (str, bytes)) else json.dumps(m)
+            for value in values:
                 await asyncio.to_thread(produce, self.topic, value)
         else:
-            for m in messages:
-                value = m if isinstance(m, (str, bytes)) else json.dumps(m)
+            for value in values:
                 produce(self.topic, value)
         if txid is not None:
             self.state.put("last_txid", txid)

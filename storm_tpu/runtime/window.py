@@ -104,18 +104,22 @@ class WindowedBolt(Bolt):
                 1 for _, ts in self._buf if now - ts <= self.window_s - self.slide_s
             )
             self._last_fire = now
-        if not window:
-            return
-        try:
-            await self.execute_window(window)
-        except Exception as e:
-            # Fail the whole buffer: windows are the unit of replay.
-            self.collector.report_error(e)
-            while self._buf:
-                t, _ = self._buf.popleft()
-                self.collector.fail(t)
-            self._since_fire = 0
-            return
+        if window:
+            try:
+                await self.execute_window(window)
+            except Exception as e:
+                # Fail the whole buffer: windows are the unit of replay.
+                self.collector.report_error(e)
+                while self._buf:
+                    t, _ = self._buf.popleft()
+                    self.collector.fail(t)
+                self._since_fire = 0
+                return
+        # Trim even when this window was empty: tuples past every future
+        # window must be expiry-acked regardless (every buffered tuple has
+        # ridden at least one fired window by induction on the inclusion
+        # rule above — an un-trimmed leftover would sit unacked until the
+        # ledger timeout).
         while len(self._buf) > keep:
             t, _ = self._buf.popleft()
             self.collector.ack(t)

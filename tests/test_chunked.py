@@ -1,0 +1,134 @@
+"""Chunked ingestion (BrokerSpout chunk=N + InferenceBolt _ChunkHandle):
+one tuple per N records — the host-side throughput lever that keeps the
+reference's one-instance-per-message wire contract."""
+
+import asyncio
+import json
+
+import numpy as np
+
+from storm_tpu.api.schema import decode_predictions
+from storm_tpu.config import BatchConfig, Config, ModelConfig, OffsetsConfig, ShardingConfig
+from storm_tpu.connectors import BrokerSink, BrokerSpout, MemoryBroker
+from storm_tpu.infer import InferenceBolt
+from storm_tpu.runtime import TopologyBuilder
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+
+def _payload(seed=0):
+    rng = np.random.RandomState(seed)
+    return json.dumps({"instances": rng.rand(1, 28, 28, 1).tolist()})
+
+
+async def _run_chunked(n_msgs, poison_at=None, chunk=4):
+    broker = MemoryBroker(default_partitions=2)
+    cfg = Config()
+    tb = TopologyBuilder()
+    tb.set_spout(
+        "spout",
+        BrokerSpout(broker, "input", OffsetsConfig(policy="earliest", max_behind=None),
+                    chunk=chunk),
+        parallelism=2,
+    )
+    tb.set_bolt(
+        "infer",
+        InferenceBolt(ModelConfig(name="lenet5", input_shape=(28, 28, 1)),
+                      BatchConfig(max_batch=8, max_wait_ms=10, buckets=(8,)),
+                      ShardingConfig(data_parallel=0), warmup=False),
+        parallelism=2,
+    ).shuffle_grouping("spout")
+    tb.set_bolt("sink", BrokerSink(broker, "output", cfg.sink), parallelism=1)\
+        .shuffle_grouping("infer")
+    tb.set_bolt("dlq", BrokerSink(broker, "dead-letter", cfg.sink), parallelism=1)\
+        .shuffle_grouping("infer", stream="dead_letter")
+
+    for i in range(n_msgs):
+        if poison_at is not None and i == poison_at:
+            broker.produce("input", '{"instances": "garbage"}')
+        else:
+            broker.produce("input", _payload(seed=i))
+
+    cluster = AsyncLocalCluster()
+    rt = await cluster.submit("chunked", cfg, tb.build())
+    deadline = asyncio.get_event_loop().time() + 60
+    want = n_msgs
+    while asyncio.get_event_loop().time() < deadline:
+        if broker.topic_size("output") + broker.topic_size("dead-letter") >= want:
+            break
+        await asyncio.sleep(0.05)
+    await rt.drain(timeout_s=30)
+    snap = rt.metrics.snapshot()
+    outs = broker.drain_topic("output")
+    dlq = broker.drain_topic("dead-letter")
+    await cluster.shutdown()
+    return outs, dlq, snap
+
+
+def test_chunked_ingestion_end_to_end(run):
+    outs, dlq, snap = run(_run_chunked(n_msgs=25, chunk=4), timeout=120)
+    assert len(outs) == 25 and len(dlq) == 0
+    for r in outs:
+        preds = decode_predictions(r.value)
+        assert preds.data.shape == (1, 10)
+        np.testing.assert_allclose(preds.data.sum(), 1.0, atol=1e-4)
+    assert snap["infer"]["instances_inferred"] == 25
+    # chunked: far fewer spout ledger entries than records
+    assert snap["spout"]["tree_acked"] < 25
+    assert snap["spout"]["tree_acked"] >= 1
+
+
+def test_chunked_poison_dead_letters_without_wedging_chunk(run):
+    outs, dlq, snap = run(_run_chunked(n_msgs=12, poison_at=5, chunk=4), timeout=120)
+    # 11 good records predicted, poison dead-lettered; its chunk-mates
+    # still produced output (the chunk was not failed/replayed)
+    assert len(outs) == 11
+    assert len(dlq) == 1
+    assert snap["infer"]["dead_lettered"] == 1
+    assert snap["spout"].get("tree_failed", 0) == 0
+
+
+def test_chunk_replay_is_whole_chunk(run):
+    async def go():
+        from storm_tpu.connectors.memory import MemoryBroker as MB
+
+        broker = MB(default_partitions=1)
+        for i in range(6):
+            broker.produce("in", f"m{i}")
+        spout = BrokerSpout(broker, "in",
+                            OffsetsConfig(policy="earliest", max_behind=None),
+                            chunk=3)
+
+        emits = []
+
+        class Cap:
+            def set_output_fields(self, f):
+                pass
+
+            async def emit(self, values, **kw):
+                emits.append((list(values), kw.get("msg_id")))
+                return 1
+
+        class Ctx:
+            task_index = 0
+            parallelism = 1
+            component_id = "spout"
+            config = None
+            metrics = None
+
+        spout.open(Ctx(), Cap())
+        # one fetch -> ALL its records emitted, sliced into chunk tuples
+        assert await spout.next_tuple()
+        (chunk1,), mid1 = emits[0]
+        (chunk2,), mid2 = emits[1]
+        assert chunk1 == ["m0", "m1", "m2"] and mid1[0] == "c"
+        assert chunk2 == ["m3", "m4", "m5"] and mid2[0] == "c"
+        # fail -> the whole chunk replays as one identical tuple
+        spout.fail(mid1)
+        assert await spout.next_tuple()
+        (chunk1r,), mid1r = emits[2]
+        assert chunk1r == chunk1 and mid1r == mid1
+        spout.ack(mid1r)
+        spout.ack(mid2)
+        assert not await spout.next_tuple()  # log drained
+
+    run(go(), timeout=30)

@@ -183,3 +183,42 @@ def test_late_tick_still_windows_stalled_tuples(run):
         assert [m for w in CollectWindows.windows for m in w] == ["x0", "x1", "x2"]
 
     run(go(), timeout=10)
+
+
+def test_expired_tuple_acked_on_empty_window(run):
+    """A tuple kept after a fired window, then aged past window_s by a
+    stall, must be expiry-acked by the next tick even though that window
+    is empty — not left buffered until the ledger timeout."""
+
+    class _Coll:
+        def __init__(self):
+            self.acked, self.failed = [], []
+
+        def ack(self, t):
+            self.acked.append(t)
+
+        def fail(self, t):
+            self.failed.append(t)
+
+        def report_error(self, e):
+            raise e
+
+    async def go():
+        CollectWindows.windows = []
+        bolt = CollectWindows(window_s=10.0, slide_s=5.0)
+        bolt.collector = _Coll()
+        from storm_tpu.runtime.tuples import Tuple as T
+
+        t = T(values=["x"], fields=("message",), source_component="s", source_task=0)
+        await bolt.execute(t)
+        await bolt.tick()  # first window fires, tuple kept (age < w - s)
+        assert CollectWindows.windows == [["x"]]
+        assert bolt.collector.acked == []
+        # stall: tuple is now older than window_s, and the last fire saw it
+        bolt._buf = type(bolt._buf)((tt, ts - 60.0) for tt, ts in bolt._buf)
+        bolt._last_fire -= 30.0
+        await bolt.tick()  # empty window, but the trim must expiry-ack
+        assert bolt.collector.acked == [t]
+        assert CollectWindows.windows == [["x"]]  # no second (empty) window
+
+    run(go(), timeout=10)
