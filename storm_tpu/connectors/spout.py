@@ -131,7 +131,10 @@ class BrokerSpout(Spout):
                 records = self.broker.fetch(self.topic, p, pos, self.fetch_size)
             if not records:
                 continue
-            self.positions[p] = records[-1].offset + 1
+            # Emit FIRST, advance the cursor after: an exception mid-loop
+            # (executor catches and retries next_tuple) must re-fetch the
+            # unemitted tail — duplicates are the safe direction for
+            # at-least-once; a skipped record is not.
             if self.chunk > 1:
                 # One full-size fetch (one broker round trip), sliced into
                 # chunk tuples — NOT one fetch per chunk, which would
@@ -142,6 +145,7 @@ class BrokerSpout(Spout):
             else:
                 for rec in records:
                     await self._emit(rec)
+            self.positions[p] = records[-1].offset + 1
             return True
         return False
 

@@ -355,12 +355,24 @@ class DistCluster:
                 ok = c.control("drain", timeout_s=timeout_s).get("ok", False) and ok
             return ok
 
+    def deactivate(self) -> None:
+        """Stop spouts pulling; in-flight tuples keep flowing (the first
+        phase of drain(), without the drain wait)."""
+        with self._lock:
+            self._activated = False
+            for c in self.clients:
+                c.control("deactivate")
+
     def activate(self) -> None:
         """Resume spouts after a deactivate/drain (Storm's 'activate')."""
         with self._lock:
             self._activated = True
             for c in self.clients:
                 c.control("activate")
+
+    @property
+    def activated(self) -> bool:
+        return self._activated
 
     def kill(self, wait_secs: float = 0.0) -> None:
         with self._lock:

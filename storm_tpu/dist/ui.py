@@ -1,0 +1,174 @@
+"""UI adapter for the distributed runtime: serve the same Storm-UI HTTP API
+(:mod:`storm_tpu.runtime.ui`) over a :class:`~storm_tpu.dist.DistCluster`.
+
+The local UI server reads ``AsyncLocalCluster``/``TopologyRuntime``
+directly; the dist controller is synchronous (blocking gRPC clients to
+worker processes), so this module wraps it in duck-typed async views:
+
+- :class:`DistRuntimeView` — looks like a ``TopologyRuntime`` to the
+  routes: ``health()`` aggregates per-worker health (component rows come
+  from the worker that hosts the component; in-flight trees are summed),
+  ``metrics.snapshot()`` is the controller's placement-merged snapshot,
+  and the lifecycle actions run the blocking controller calls off-loop.
+- :class:`DistClusterView` — the ``runtimes``/``kill`` surface.
+
+Prometheus note: worker snapshots arrive as plain JSON, so metric *kind*
+is inferred from value type here (int -> counter, float -> gauge, dict ->
+histogram) — unlike the in-process path, which reads kinds from the live
+registry. Workers only ever serialize counters as ints and gauges as
+floats, so the inference is faithful to what they sent.
+
+Usage (wired into ``storm_tpu dist-run --ui-port N``)::
+
+    ui = await start_dist_ui(dist, name, port)
+    ...
+    await ui.stop()
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List
+
+from storm_tpu.runtime.ui import UIServer
+
+
+class _Value:
+    __slots__ = ("value",)
+
+    def __init__(self, v) -> None:
+        self.value = v
+
+
+class _Hist:
+    """Histogram facade over a worker's snapshot dict (for prometheus_text)."""
+
+    def __init__(self, snap: Dict[str, Any]) -> None:
+        self._snap = dict(snap)
+        self.count = snap.get("count", 0)
+        self.sum = snap.get("sum", float("nan"))
+
+    def snapshot(self) -> Dict[str, Any]:
+        return self._snap
+
+
+class DistMetrics:
+    """Registry facade over the controller's merged metrics snapshot.
+
+    One Prometheus scrape reads ``_counters``/``_gauges``/``_histograms``
+    in sequence; the worker fan-out runs ONCE per scrape (short-TTL cache)
+    so the three views are consistent and the RPC cost is 1x, not 3x."""
+
+    _TTL_S = 0.5
+
+    def __init__(self, dist) -> None:
+        self._dist = dist
+        self._cached = None
+        self._cached_at = 0.0
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        return self._dist.metrics()
+
+    def _split(self):
+        import time
+
+        now = time.monotonic()
+        if self._cached is not None and now - self._cached_at < self._TTL_S:
+            return self._cached
+        counters, gauges, hists = {}, {}, {}
+        for comp, vals in self.snapshot().items():
+            for name, v in vals.items():
+                key = (comp, name)
+                if isinstance(v, dict):
+                    hists[key] = _Hist(v)
+                elif isinstance(v, bool):
+                    gauges[key] = _Value(float(v))
+                elif isinstance(v, int):
+                    counters[key] = _Value(v)
+                else:
+                    gauges[key] = _Value(v)
+        self._cached = (counters, gauges, hists)
+        self._cached_at = now
+        return self._cached
+
+    @property
+    def _counters(self):
+        return self._split()[0]
+
+    @property
+    def _gauges(self):
+        return self._split()[1]
+
+    @property
+    def _histograms(self):
+        return self._split()[2]
+
+
+class DistRuntimeView:
+    """TopologyRuntime look-alike over a DistCluster, async at the edges."""
+
+    def __init__(self, dist, name: str) -> None:
+        self._dist = dist
+        self.name = name
+        self.metrics = DistMetrics(dist)
+        self.errors: List = []  # worker errors surface via worker logs
+
+    def is_active(self) -> bool:
+        return self._dist.activated
+
+    def health(self) -> Dict[str, Any]:
+        per_worker = self._dist.health()
+        components: Dict[str, Any] = {}
+        inflight = 0
+        placement = self._dist._placement
+        for widx, h in per_worker.items():
+            inflight += h.get("inflight_trees", 0)
+            for cid, info in h.get("components", {}).items():
+                # the hosting worker's row wins; proxy rows fill gaps
+                if placement.get(cid) == widx or cid not in components:
+                    components[cid] = info
+        return {
+            "topology": self.name,
+            "inflight_trees": inflight,
+            "workers": sorted(per_worker),
+            "components": components,
+        }
+
+    async def activate(self) -> None:
+        await asyncio.to_thread(self._dist.activate)
+
+    async def deactivate(self) -> None:
+        await asyncio.to_thread(self._dist.deactivate)
+
+    async def rebalance(self, component: str, parallelism: int) -> None:
+        await asyncio.to_thread(self._dist.rebalance, component, parallelism)
+
+    async def kill(self, wait_secs: float = 0.0) -> None:
+        await asyncio.to_thread(self._dist.kill, wait_secs)
+
+
+class DistClusterView:
+    """The ``runtimes`` surface UIServer expects, over one dist topology."""
+
+    def __init__(self, dist, name: str) -> None:
+        self._view = DistRuntimeView(dist, name)
+        self._killed = False
+
+    @property
+    def runtimes(self) -> Dict[str, DistRuntimeView]:
+        return {} if self._killed else {self._view.name: self._view}
+
+    def runtime(self, name: str) -> DistRuntimeView:
+        return self.runtimes[name]
+
+    async def kill(self, name: str, wait_secs: float = 0.0) -> None:
+        if self._killed or name != self._view.name:
+            return
+        self._killed = True
+        await self._view.kill(wait_secs)
+
+
+async def start_dist_ui(dist, name: str, port: int = 0,
+                        host: str = "127.0.0.1") -> UIServer:
+    """Serve the Storm-UI HTTP API for a running DistCluster topology."""
+    return await UIServer(DistClusterView(dist, name), host=host, port=port).start()

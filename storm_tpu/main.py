@@ -240,6 +240,9 @@ def main(argv=None) -> int:
                        help="attach to pre-started workers instead of "
                             "spawning (multi-host)")
     distp.add_argument("--duration", type=float, default=0.0)
+    distp.add_argument("--ui-port", type=int, default=-1,
+                       help="serve the Storm-UI HTTP API over the dist "
+                            "controller (0 = ephemeral, -1 = off)")
 
     servep = sub.add_parser("serve", help="run the gRPC TPU inference worker")
     servep.add_argument("--config", help="TOML/JSON config file")
@@ -287,6 +290,21 @@ def main(argv=None) -> int:
             placement = cluster.submit(args.name, cfg, builder=builder)
             print(f"topology {args.name!r} across {len(cluster.clients)} "
                   f"workers: {placement}", file=sys.stderr)
+            ui = ui_loop = None
+            if args.ui_port >= 0:
+                # The dist controller is synchronous; the UI server runs on
+                # its own loop in a daemon thread, calling the controller
+                # off-loop through the DistRuntimeView adapter.
+                import threading
+
+                from storm_tpu.dist.ui import start_dist_ui
+
+                ui_loop = asyncio.new_event_loop()
+                threading.Thread(target=ui_loop.run_forever, daemon=True).start()
+                ui = asyncio.run_coroutine_threadsafe(
+                    start_dist_ui(cluster, args.name, args.ui_port), ui_loop
+                ).result(timeout=10)
+                print(f"ui http://127.0.0.1:{ui.port}", file=sys.stderr)
             try:
                 if args.duration > 0:
                     time.sleep(args.duration)
@@ -294,6 +312,9 @@ def main(argv=None) -> int:
                     signal.sigwait({signal.SIGINT, signal.SIGTERM})
             except KeyboardInterrupt:
                 pass
+            if ui is not None:
+                asyncio.run_coroutine_threadsafe(ui.stop(), ui_loop).result(timeout=10)
+                ui_loop.call_soon_threadsafe(ui_loop.stop)
             print("draining...", file=sys.stderr)
             cluster.drain(timeout_s=30)
             print(json.dumps(cluster.metrics(), default=str), file=sys.stderr)

@@ -156,18 +156,20 @@ class UIServer:
         if path == "/healthz":
             return 200, {"status": "ok", "uptime_s": round(time.monotonic() - self._started, 3)}
         if path == "/metrics":
-            # Prometheus text exposition over every live topology.
+            # Prometheus text exposition over every live topology. Off-loop:
+            # a dist-backed registry fans out blocking RPCs to workers, and
+            # a slow worker must not freeze every other route.
             from storm_tpu.runtime.metrics import prometheus_text
 
-            text = prometheus_text(
-                {name: rt.metrics for name, rt in self._runtimes().items()}
-            )
+            regs = {name: rt.metrics for name, rt in self._runtimes().items()}
+            text = await asyncio.to_thread(prometheus_text, regs)
             return 200, _PlainText(text)
         if path == "/api/v1/cluster/summary":
             return 200, self._cluster_summary()
         if path == "/api/v1/topology/summary":
-            return 200, {"topologies": [self._topo_summary(rt)
-                                        for rt in self._runtimes().values()]}
+            rts = list(self._runtimes().values())
+            return 200, {"topologies": await asyncio.to_thread(
+                lambda: [self._topo_summary(rt) for rt in rts])}
         if path.startswith("/api/v1/drpc/"):
             if method != "POST":
                 return 405, {"error": "drpc is POST"}
@@ -209,12 +211,13 @@ class UIServer:
             if not action:
                 if method != "GET":
                     return 405, {"error": "use GET"}
-                return 200, self._topo_detail(rt)
+                # off-loop: dist-backed health()/snapshot() block on worker RPCs
+                return 200, await asyncio.to_thread(self._topo_detail, rt)
             if action in ("metrics", "errors"):
                 if method != "GET":
                     return 405, {"error": "use GET"}
                 if action == "metrics":
-                    return 200, rt.metrics.snapshot()
+                    return 200, await asyncio.to_thread(rt.metrics.snapshot)
                 return 200, {"errors": [
                     {"component": cid, "task": idx, "error": repr(err)}
                     for cid, idx, err in rt.errors
@@ -235,9 +238,12 @@ class UIServer:
 
     def _topo_summary(self, rt) -> Dict[str, Any]:
         h = rt.health()
-        active = all(
-            e._active for execs in rt.spout_execs.values() for e in execs
-        ) if rt.spout_execs else True
+        if hasattr(rt, "is_active"):  # dist adapter and other views
+            active = rt.is_active()
+        else:
+            active = all(
+                e._active for execs in rt.spout_execs.values() for e in execs
+            ) if rt.spout_execs else True
         return {
             "name": rt.name,
             "status": "ACTIVE" if active else "INACTIVE",
