@@ -64,6 +64,9 @@ class ModelConfig:
     num_classes: int = 10
     input_shape: tuple = (28, 28, 1)  # per-instance HWC
     seed: int = 0
+    # Extra kwargs for the registry builder (e.g. mobilenetv2 width=0.5,
+    # vit depth overrides) — family-specific knobs without config schema churn.
+    extra: dict = dataclasses.field(default_factory=dict)
     # Wire dtype for the host->device transfer. None ships the compute dtype
     # (bf16 = half the bytes of f32); "uint8" affine-quantizes per batch on
     # the host and dequantizes on device inside the jit program — 4x fewer

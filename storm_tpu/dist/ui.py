@@ -46,7 +46,11 @@ class _Hist:
     def __init__(self, snap: Dict[str, Any]) -> None:
         self._snap = dict(snap)
         self.count = snap.get("count", 0)
-        self.sum = snap.get("sum", float("nan"))
+        total = snap.get("sum")
+        if total is None:  # older worker snapshots: reconstruct
+            mean = snap.get("mean")
+            total = mean * self.count if mean is not None else float("nan")
+        self.sum = total
 
     def snapshot(self) -> Dict[str, Any]:
         return self._snap

@@ -43,6 +43,7 @@ class InferenceEngine:
             model_cfg.name,
             num_classes=model_cfg.num_classes,
             input_shape=tuple(model_cfg.input_shape),
+            **getattr(model_cfg, "extra", {}),
         )
         self.dtype = jnp.dtype(model_cfg.dtype)
         self.mesh = mesh if mesh is not None else make_mesh(
@@ -172,6 +173,15 @@ _ENGINES: Dict[tuple, InferenceEngine] = {}
 _ENGINES_LOCK = threading.Lock()
 
 
+def _freeze(v):
+    """Hashable deep-freeze for cache keys (TOML arrays arrive as lists)."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
 def shared_engine(
     model_cfg: ModelConfig,
     sharding_cfg: Optional[ShardingConfig] = None,
@@ -188,6 +198,10 @@ def shared_engine(
         model_cfg.num_classes,
         model_cfg.checkpoint,
         model_cfg.seed,
+        # builder kwargs are part of the model identity (width=0.5 vs 1.0
+        # must not share one cached engine); deep-freeze so TOML-sourced
+        # list values stay hashable
+        _freeze(getattr(model_cfg, "extra", {})),
         (sharding_cfg.data_parallel, sharding_cfg.tensor_parallel)
         if sharding_cfg
         else None,

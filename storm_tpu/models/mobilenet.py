@@ -1,0 +1,151 @@
+"""MobileNetV2 — inverted residual bottlenecks with depthwise convolutions.
+
+Widens the zoo beyond the reference's MNIST/CIFAR CNNs (README.md:16-18)
+with the standard efficient-inference family. TPU notes: depthwise convs
+ride ``feature_group_count`` (XLA lowers them onto the vector unit; the
+1x1 expand/project convs are the MXU work), ReLU6 everywhere, BatchNorm
+state threaded functionally like the ResNets.
+
+Width multiplier and input size are configurable; the stage table is the
+MobileNetV2 paper's (t, c, n, s). For small inputs (CIFAR) the stem stride
+and the first downsampling stage drop to stride 1, the usual CIFAR
+adaptation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from storm_tpu.models.registry import ModelDef, register
+from storm_tpu.ops import layers as L
+
+# (expansion t, out channels c, repeats n, stride s) — MobileNetV2 paper tbl 2
+_STAGES = [
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+]
+
+
+def _cbn_init(rng, kh, kw, cin, cout):
+    p = L.conv_init(rng, kh, kw, cin, cout, bias=False)
+    bn_p, bn_s = L.batchnorm_init(cout)
+    return {"conv": p, "bn": bn_p}, {"bn": bn_s}
+
+
+def _dwbn_init(rng, c):
+    p = L.depthwise_conv_init(rng, 3, 3, c)
+    bn_p, bn_s = L.batchnorm_init(c)
+    return {"dw": p, "bn": bn_p}, {"bn": bn_s}
+
+
+def _inverted_residual_init(rng, cin, cout, t):
+    keys = jax.random.split(rng, 3)
+    cmid = cin * t
+    p, s = {}, {}
+    if t != 1:
+        p["expand"], s["expand"] = _cbn_init(keys[0], 1, 1, cin, cmid)
+    p["dw"], s["dw"] = _dwbn_init(keys[1], cmid)
+    p["project"], s["project"] = _cbn_init(keys[2], 1, 1, cmid, cout)
+    return p, s
+
+
+def _inverted_residual(p, s, x, stride, train):
+    new_s = {}
+    y = x
+    if "expand" in p:
+        y = L.conv2d(p["expand"]["conv"], y, padding="SAME")
+        y, bn = L.batchnorm(p["expand"]["bn"], s["expand"]["bn"], y, train=train)
+        new_s["expand"] = {"bn": bn}
+        y = L.relu6(y)
+    y = L.depthwise_conv2d(p["dw"]["dw"], y, stride=stride, padding="SAME")
+    y, bn = L.batchnorm(p["dw"]["bn"], s["dw"]["bn"], y, train=train)
+    new_s["dw"] = {"bn": bn}
+    y = L.relu6(y)
+    y = L.conv2d(p["project"]["conv"], y, padding="SAME")
+    y, bn = L.batchnorm(p["project"]["bn"], s["project"]["bn"], y, train=train)
+    new_s["project"] = {"bn": bn}
+    if stride == 1 and x.shape[-1] == y.shape[-1]:
+        y = y + x
+    return y, new_s
+
+
+def _round_c(c: float, divisor: int = 8) -> int:
+    """The paper implementations' _make_divisible: round to the nearest
+    multiple of 8, never rounding down by more than 10%."""
+    new_c = max(divisor, int(c + divisor / 2) // divisor * divisor)
+    if new_c < 0.9 * c:
+        new_c += divisor
+    return new_c
+
+
+@register("mobilenetv2")
+def build_mobilenetv2(
+    num_classes: int = 1000,
+    input_shape: tuple = (224, 224, 3),
+    width: float = 1.0,
+) -> ModelDef:
+    small_input = input_shape[0] <= 64  # CIFAR-style adaptation
+    stem_stride = 1 if small_input else 2
+    head_c = _round_c(1280 * max(1.0, width))
+    # One stride table shared by init and apply — the CIFAR first-downsample
+    # override must never desync between shape init and forward.
+    strides = []
+    for si, (t, c, n, s0) in enumerate(_STAGES):
+        for b in range(n):
+            stride = s0 if b == 0 else 1
+            if small_input and si == 1 and b == 0:
+                stride = 1
+            strides.append(stride)
+
+    def init(rng):
+        keys = jax.random.split(rng, 4 + sum(n for _, _, n, _ in _STAGES))
+        ki = iter(keys)
+        params, state = {}, {}
+        params["stem"], state["stem"] = _cbn_init(
+            next(ki), 3, 3, input_shape[-1], _round_c(32 * width))
+        cin = _round_c(32 * width)
+        blocks_p, blocks_s = [], []
+        for t, c, n, _s0 in _STAGES:
+            cout = _round_c(c * width)
+            for _b in range(n):
+                bp, bs = _inverted_residual_init(next(ki), cin, cout, t)
+                blocks_p.append(bp)
+                blocks_s.append(bs)
+                cin = cout
+        params["blocks"] = blocks_p
+        state["blocks"] = blocks_s
+        params["head"], state["head"] = _cbn_init(next(ki), 1, 1, cin, head_c)
+        params["fc"] = L.dense_init(next(ki), head_c, num_classes)
+        return params, state
+
+    def apply(params, state, x, train: bool = False):
+        new_state = {}
+        y = L.conv2d(params["stem"]["conv"], x, stride=stem_stride, padding="SAME")
+        y, bn = L.batchnorm(params["stem"]["bn"], state["stem"]["bn"], y, train=train)
+        new_state["stem"] = {"bn": bn}
+        y = L.relu6(y)
+        blocks_s = []
+        for bp, bs, stride in zip(params["blocks"], state["blocks"], strides):
+            y, ns = _inverted_residual(bp, bs, y, stride, train)
+            blocks_s.append(ns)
+        new_state["blocks"] = blocks_s
+        y = L.conv2d(params["head"]["conv"], y, padding="SAME")
+        y, bn = L.batchnorm(params["head"]["bn"], state["head"]["bn"], y, train=train)
+        new_state["head"] = {"bn": bn}
+        y = L.relu6(y)
+        y = L.global_avg_pool(y)
+        return L.dense(params["fc"], y), new_state
+
+    return ModelDef(
+        name="mobilenetv2",
+        input_shape=tuple(input_shape),
+        num_classes=num_classes,
+        init=init,
+        apply=apply,
+    )

@@ -47,7 +47,7 @@ def register(name: str) -> Callable:
 
 def _load_builtin() -> None:
     # Import model modules lazily so registration happens on demand.
-    from storm_tpu.models import lenet, moe_vit, resnet, vit  # noqa: F401
+    from storm_tpu.models import lenet, mixer, mobilenet, moe_vit, resnet, vit  # noqa: F401
 
 
 def registry_names() -> list:

@@ -162,3 +162,34 @@ def layernorm(p: dict, x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
 relu = jax.nn.relu
 gelu = jax.nn.gelu
 softmax = jax.nn.softmax
+
+
+def relu6(x: jnp.ndarray) -> jnp.ndarray:
+    """min(max(x, 0), 6) — MobileNet's quantization-friendly activation."""
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def depthwise_conv_init(rng, kh: int, kw: int, c: int, dtype=jnp.float32) -> dict:
+    """Per-channel (depthwise) kernel: HWIO with I=1, grouped over channels."""
+    return {"w": he_normal(rng, (kh, kw, 1, c), kh * kw, dtype)}
+
+
+def depthwise_conv2d(
+    p: dict,
+    x: jnp.ndarray,
+    stride: int | Tuple[int, int] = 1,
+    padding: str | Sequence[Tuple[int, int]] = "SAME",
+) -> jnp.ndarray:
+    """NHWC depthwise convolution (feature_group_count = channels)."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    c = x.shape[-1]
+    return lax.conv_general_dilated(
+        x,
+        p["w"].astype(x.dtype),
+        window_strides=stride,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)

@@ -33,7 +33,9 @@ class Gauge:
         self.value = 0.0
 
     def set(self, v: float) -> None:
-        self.value = v
+        # float coercion keeps the serialized kind stable: ints would make a
+        # remote snapshot reader (dist UI) classify the gauge as a counter.
+        self.value = float(v)
 
 
 class Histogram:
@@ -68,6 +70,7 @@ class Histogram:
 
         return {
             "count": self.count,
+            "sum": clean(self.sum) if self.count else None,
             "mean": clean(self.mean),
             "p50": clean(self.percentile(50)),
             "p95": clean(self.percentile(95)),

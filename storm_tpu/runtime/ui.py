@@ -236,8 +236,8 @@ class UIServer:
             "topologies": sorted(self._runtimes()),
         }
 
-    def _topo_summary(self, rt) -> Dict[str, Any]:
-        h = rt.health()
+    def _topo_summary(self, rt, health: Dict[str, Any] = None) -> Dict[str, Any]:
+        h = health if health is not None else rt.health()
         if hasattr(rt, "is_active"):  # dist adapter and other views
             active = rt.is_active()
         else:
@@ -252,10 +252,14 @@ class UIServer:
         }
 
     def _topo_detail(self, rt) -> Dict[str, Any]:
-        summary = self._topo_summary(rt)
+        # One health fetch serves both summary and detail: on the dist
+        # backend each fetch is a per-worker RPC fan-out, and two fetches
+        # could disagree mid-rebalance.
+        health = rt.health()
+        summary = self._topo_summary(rt, health)
         snap = rt.metrics.snapshot()
         comps = {}
-        for cid, info in rt.health()["components"].items():
+        for cid, info in health["components"].items():
             m = snap.get(cid, {})
             comps[cid] = {
                 "tasks": info["tasks"],

@@ -145,3 +145,53 @@ def test_moe_vit_serves_through_engine():
     out = eng.predict(np.random.rand(3, 32, 32, 3).astype(np.float32))
     assert out.shape == (3, 10)
     np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_mobilenetv2_shapes_cifar():
+    model, logits, *_ = _fwd("mobilenetv2", num_classes=10,
+                             input_shape=(32, 32, 3), width=0.5)
+    assert logits.shape == (2, 10)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_mobilenetv2_bn_state_and_residuals():
+    model = build_model("mobilenetv2", num_classes=10,
+                        input_shape=(32, 32, 3), width=0.5)
+    params, state = init_params(model, 0)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 32, 3)) * 2
+    _, new_state = model.apply(params, state, x, train=True)
+    before = state["stem"]["bn"]["mean"]
+    after = new_state["stem"]["bn"]["mean"]
+    assert not bool(jnp.all(before == after))
+    # inference leaves state untouched
+    _, same = model.apply(params, state, x, train=False)
+    assert bool(jnp.all(same["stem"]["bn"]["mean"] == before))
+
+
+def test_mixer_shapes_and_stateless():
+    model, logits, params, state = _fwd("mixer_tiny")
+    assert logits.shape == (2, 10)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    assert state == {}
+
+
+def test_mixer_patch_divisibility():
+    with pytest.raises(ValueError):
+        build_model("mixer_tiny", input_shape=(30, 30, 3))
+
+
+def test_new_families_serve_through_engine():
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.infer.engine import InferenceEngine
+
+    for name, shape in [("mobilenetv2", (32, 32, 3)), ("mixer_tiny", (32, 32, 3))]:
+        eng = InferenceEngine(
+            ModelConfig(name=name, input_shape=shape, num_classes=10,
+                        dtype="float32",
+                        extra={"width": 0.5} if name == "mobilenetv2" else {}),
+            ShardingConfig(data_parallel=0),
+            BatchConfig(max_batch=4, buckets=(4,)),
+        )
+        out = eng.predict(np.random.rand(3, *shape).astype(np.float32))
+        assert out.shape == (3, 10)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-4)
