@@ -154,6 +154,16 @@ class BrokerConfig:
     output_topic: str = "output"
     dead_letter_topic: str = "dead-letter"
     partitions: int = 4  # partitions for memory broker topics
+    # 'v1' = 0.11-era message sets (the reference's broker generation);
+    # 'v2' = KIP-98 record batches (CRC32C), what modern brokers store.
+    message_format: str = "v1"
+
+    def __post_init__(self) -> None:
+        if self.kind not in ("memory", "kafka"):
+            raise ValueError(f"broker.kind must be memory|kafka, got {self.kind!r}")
+        if self.message_format not in ("v1", "v2"):
+            raise ValueError(
+                f"broker.message_format must be v1|v2, got {self.message_format!r}")
 
 
 def _apply_section(target, values: dict) -> None:

@@ -7,7 +7,8 @@ old, stable, non-flexible encodings every broker since 0.10 accepts —
 the same era as the reference's Kafka 0.11 (pom.xml:55-78):
 
 - Metadata v0 (api 3) — brokers + partition leaders
-- Produce v2 (api 0) — message-format v1 sets (crc/magic/attrs/ts/key/value)
+- Produce v2/v3 (api 0) — message-format v1 sets, or KIP-98 RecordBatch v2
+  (CRC32C + zigzag-varint records; ``message_format='v2'``)
 - Fetch v2 (api 1) — brokers down-convert to message format v1
 - ListOffsets v0 (api 2) — latest (-1) / earliest (-2)
 - FindCoordinator v0 (api 10) — group coordinator for offset storage
@@ -144,6 +145,14 @@ def decode_message_set(topic: str, partition: int, data: bytes) -> List[Record]:
     records: List[Record] = []
     r = Reader(data)
     while r.remaining >= 12:
+        # Sniff the magic byte (offset 16 in both framings: v0/v1 put it
+        # after offset+size+crc, v2 after baseOffset+batchLength+leaderEpoch)
+        if len(data) - r.pos >= 17 and data[r.pos + 16] == 2:
+            batch, consumed = decode_record_batch(
+                topic, partition, data[r.pos:])
+            records.extend(batch)
+            r.pos += consumed
+            continue
         offset = r.i64()
         size = r.i32()
         if r.remaining < size:
@@ -151,11 +160,8 @@ def decode_message_set(topic: str, partition: int, data: bytes) -> List[Record]:
         body = Reader(r._take(size))
         body.i32()  # crc (trusted; TCP already checksums)
         magic = body.i8()
-        if magic == 2:
-            raise KafkaProtocolError(
-                "broker returned record-batch format (magic 2); request a "
-                "Fetch version the broker down-converts for"
-            )
+        if magic == 2:  # unreachable after the sniff; defensive
+            raise KafkaProtocolError("unexpected magic 2 in message set")
         attrs = body.i8()
         codec = attrs & 0x07
         ts = body.i64() / 1e3 if magic == 1 else time.time()
@@ -183,6 +189,166 @@ def decode_message_set(topic: str, partition: int, data: bytes) -> List[Record]:
             ]
         records.extend(inner)
     return records
+
+
+# ---- record batches (format v2, KIP-98) --------------------------------------
+
+
+def _zigzag_encode(v: int) -> int:
+    return (v << 1) ^ (v >> 63)
+
+
+def _zigzag_decode(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _write_varint(out: bytearray, v: int) -> None:
+    u = _zigzag_encode(v) & 0xFFFFFFFFFFFFFFFF
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    shift = 0
+    u = 0
+    while True:
+        if pos >= len(data):
+            raise KafkaProtocolError("truncated varint in record batch")
+        b = data[pos]
+        pos += 1
+        u |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return _zigzag_decode(u), pos
+        shift += 7
+        if shift > 63:
+            raise KafkaProtocolError("varint overflow in record batch")
+
+
+def encode_record_batch(
+    records: List[Tuple[Optional[bytes], bytes]],
+    ts_ms: int,
+    base_offset: int = 0,
+) -> bytes:
+    """[(key, value)] -> one RecordBatch (magic 2, no compression, no
+    producer id / transactions). CRC32C (Castagnoli) covers everything
+    after the crc field, computed by the native layer when built."""
+    from storm_tpu.native import crc32c
+
+    body = bytearray()
+    for i, (key, value) in enumerate(records):
+        rec = bytearray()
+        rec.append(0)  # record attributes
+        _write_varint(rec, 0)  # timestampDelta
+        _write_varint(rec, i)  # offsetDelta
+        if key is None:
+            _write_varint(rec, -1)
+        else:
+            _write_varint(rec, len(key))
+            rec += key
+        _write_varint(rec, len(value))
+        rec += value
+        _write_varint(rec, 0)  # headers
+        _write_varint(body, len(rec))
+        body += rec
+
+    after_crc = Writer()
+    after_crc.i16(0)  # attributes: no codec, create-time, not transactional
+    after_crc.i32(len(records) - 1)  # lastOffsetDelta
+    after_crc.i64(ts_ms)  # baseTimestamp
+    after_crc.i64(ts_ms)  # maxTimestamp
+    after_crc.i64(-1)  # producerId
+    after_crc.i16(-1)  # producerEpoch
+    after_crc.i32(-1)  # baseSequence
+    after_crc.i32(len(records))
+    after_crc.raw(bytes(body))
+    crc = crc32c(bytes(after_crc.buf))
+
+    batch = Writer()
+    batch.i64(base_offset)
+    batch.i32(4 + 1 + 4 + len(after_crc.buf))  # batchLength (after this field)
+    batch.i32(-1)  # partitionLeaderEpoch
+    batch.i8(2)  # magic
+    batch.buf += struct.pack(">I", crc)
+    batch.raw(bytes(after_crc.buf))
+    return bytes(batch.buf)
+
+
+def decode_record_batch(topic: str, partition: int, data: bytes,
+                        verify_crc: bool = False) -> Tuple[List[Record], int]:
+    """One RecordBatch -> (records, bytes consumed). ``data`` starts at
+    baseOffset. Control batches (transaction markers) are skipped."""
+    r = Reader(data)
+    base_offset = r.i64()
+    batch_len = r.i32()
+    if r.remaining < batch_len:
+        return [], len(data)  # partial trailing batch (broker truncation)
+    end = r.pos + batch_len
+    r.i32()  # partitionLeaderEpoch
+    magic = r.i8()
+    if magic != 2:
+        raise KafkaProtocolError(f"expected magic 2, got {magic}")
+    crc = struct.unpack(">I", r._take(4))[0]
+    if verify_crc:
+        from storm_tpu.native import crc32c
+
+        got = crc32c(data[r.pos:end])
+        if got != crc:
+            raise KafkaProtocolError(
+                f"record batch CRC32C mismatch ({got:#x} != {crc:#x})")
+    attrs = r.i16()
+    codec = attrs & 0x07
+    is_control = bool(attrs & 0x20)
+    r.i32()  # lastOffsetDelta
+    base_ts = r.i64()
+    r.i64()  # maxTimestamp
+    r.i64()  # producerId
+    r.i16()  # producerEpoch
+    r.i32()  # baseSequence
+    count = r.i32()
+    payload = data[r.pos:end]
+    if codec == 1:
+        import gzip as _gzip
+
+        payload = _gzip.decompress(payload)
+    elif codec != 0:
+        raise KafkaProtocolError(
+            f"unsupported record-batch codec {codec} (only none/gzip)")
+    records: List[Record] = []
+    pos = 0
+    for _ in range(count):
+        rec_len, pos = _read_varint(payload, pos)
+        rec_end = pos + rec_len
+        pos += 1  # record attributes
+        ts_delta, pos = _read_varint(payload, pos)
+        off_delta, pos = _read_varint(payload, pos)
+        klen, pos = _read_varint(payload, pos)
+        key = None
+        if klen >= 0:
+            key = payload[pos:pos + klen]
+            pos = pos + klen
+        vlen, pos = _read_varint(payload, pos)
+        value = b""
+        if vlen >= 0:
+            value = payload[pos:pos + vlen]
+            pos = pos + vlen
+        n_headers, pos = _read_varint(payload, pos)
+        for _ in range(n_headers):
+            hklen, pos = _read_varint(payload, pos)
+            pos += max(0, hklen)
+            hvlen, pos = _read_varint(payload, pos)
+            pos += max(0, hvlen)
+        if pos != rec_end:
+            pos = rec_end  # tolerate forward-compatible extra fields
+        if not is_control:
+            records.append(Record(topic, partition, base_offset + off_delta,
+                                  key, value, (base_ts + ts_delta) / 1e3))
+    return records, end
 
 
 # ---- connection --------------------------------------------------------------
@@ -376,22 +542,38 @@ class KafkaWireClient:
         records: List[Tuple[Optional[bytes], bytes]],
         acks: int = 1,
         timeout_ms: int = 30000,
+        message_format: str = "v1",
     ) -> int:
-        """Returns the base offset assigned by the broker."""
-        msgset = encode_message_set(records, int(time.time() * 1e3))
+        """Returns the base offset assigned by the broker.
+
+        ``message_format='v2'`` ships a KIP-98 RecordBatch over Produce v3
+        (CRC32C, varint records) — what modern brokers store natively;
+        'v1' keeps the 0.11-era message set the reference ran against."""
+        ts_ms = int(time.time() * 1e3)
+        if message_format == "v2":
+            payload = encode_record_batch(records, ts_ms)
+            api_version = 3
+        elif message_format == "v1":
+            payload = encode_message_set(records, ts_ms)
+            api_version = 2
+        else:
+            raise KafkaProtocolError(
+                f"message_format must be v1|v2, got {message_format!r}")
         w = Writer()
+        if api_version >= 3:
+            w.string(None)  # transactional_id
         w.i16(acks).i32(timeout_ms)
         w.i32(1)
         w.string(topic)
         w.i32(1)
         w.i32(partition)
-        w.bytes_(msgset)
+        w.bytes_(payload)
         addr = self._leader_addr(topic, partition)
         if acks == 0:
             # Broker sends no response for acks=0; reading one would hang.
-            self._request(addr, 0, 2, bytes(w.buf), oneway=True)
+            self._request(addr, 0, api_version, bytes(w.buf), oneway=True)
             return -1
-        r = self._request(addr, 0, 2, bytes(w.buf))
+        r = self._request(addr, 0, api_version, bytes(w.buf))
         base_offset = -1
         for _ in range(r.i32()):  # topics
             r.string()
@@ -547,8 +729,10 @@ class KafkaWireBroker:
     #: (network calls must not block the event loop).
     blocking = True
 
-    def __init__(self, bootstrap: str, client_id: str = "storm-tpu") -> None:
+    def __init__(self, bootstrap: str, client_id: str = "storm-tpu",
+                 message_format: str = "v1") -> None:
         self.client = KafkaWireClient(bootstrap, client_id)
+        self.message_format = message_format
         self._rr = 0
         # Decoded-but-not-yet-returned tail of the last wire fetch, per
         # partition: a 1MB fetch can decode far more than max_records, and
@@ -575,7 +759,8 @@ class KafkaWireBroker:
             else:
                 partition = self._rr % n
                 self._rr += 1
-        off = self.client.produce(topic, partition, [(key, value)])
+        off = self.client.produce(topic, partition, [(key, value)],
+                                  message_format=self.message_format)
         return partition, off
 
     def fetch(self, topic, partition, offset, max_records=512):

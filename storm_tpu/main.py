@@ -110,7 +110,8 @@ def _make_broker(cfg: Config):
         # Pure-Python wire-protocol client — no client library required.
         from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
 
-        return KafkaWireBroker(cfg.broker.bootstrap)
+        return KafkaWireBroker(cfg.broker.bootstrap,
+                               message_format=cfg.broker.message_format)
     raise ValueError(f"unknown broker kind {cfg.broker.kind!r}")
 
 

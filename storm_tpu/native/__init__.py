@@ -80,6 +80,15 @@ def _load() -> Optional[ctypes.CDLL]:
             ]
         except AttributeError:  # stale .so without the tensor marshaller
             pass
+        try:
+            lib.stpu_crc32c.restype = ctypes.c_uint32
+            lib.stpu_crc32c.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_uint32,
+            ]
+        except AttributeError:  # stale .so without crc32c
+            pass
         _lib = lib
     except OSError:
         _lib = None
@@ -236,3 +245,35 @@ def format_predictions_native(arr: np.ndarray) -> Optional[str]:
     s = ctypes.string_at(ptr, length.value).decode("ascii")
     lib.stpu_free(ptr)
     return s
+
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli) — Kafka record-batch v2 checksum
+# ---------------------------------------------------------------------------
+
+_CRC32C_TABLE = None
+
+
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
+    """Pure-Python table fallback (same polynomial as crc32c.cpp)."""
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """CRC32C over ``data`` (incremental: pass a previous result as crc)."""
+    lib = _load()
+    if lib is not None and hasattr(lib, "stpu_crc32c"):
+        return lib.stpu_crc32c(data, len(data), crc)
+    return _crc32c_py(data, crc)

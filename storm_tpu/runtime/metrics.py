@@ -70,7 +70,7 @@ class Histogram:
 
         return {
             "count": self.count,
-            "sum": clean(self.sum) if self.count else None,
+            "sum": clean(self.sum),  # 0.0 when empty; None only in old snapshots
             "mean": clean(self.mean),
             "p50": clean(self.percentile(50)),
             "p95": clean(self.percentile(95)),

@@ -23,6 +23,10 @@ from storm_tpu.connectors.kafka_protocol import (
 
 
 class KafkaStubBroker:
+    #: serve fetches as record batches (magic 2) instead of message sets —
+    #: exercises the client's v2 decode over a real socket
+    serve_batches = False
+
     def __init__(self, partitions: int = 2) -> None:
         self.partitions = partitions
         self._logs: Dict[Tuple[str, int], List[Tuple[Optional[bytes], bytes, float]]] = {}
@@ -111,7 +115,7 @@ class KafkaStubBroker:
         if api == 3:
             return self._metadata(r)
         if api == 0:
-            return self._produce(r)
+            return self._produce(r, version)
         if api == 1:
             return self._fetch(r)
         if api == 2:
@@ -144,7 +148,9 @@ class KafkaStubBroker:
                 w.i32(1).i32(0)  # isr
         return bytes(w.buf)
 
-    def _produce(self, r: Reader) -> bytes:
+    def _produce(self, r: Reader, version: int = 2) -> bytes:
+        if version >= 3:
+            r.string()  # transactional_id (KIP-98)
         r.i16()  # acks
         r.i32()  # timeout
         w = Writer()
@@ -191,11 +197,22 @@ class KafkaStubBroker:
                     log = self._logs[(topic, pid)]
                     chunk = log[offset : offset + 256]
                     hw = len(log)
-                msgset = encode_message_set(
-                    [(k, v) for k, v, _ in chunk],
-                    int(time.time() * 1e3),
-                    offsets=list(range(offset, offset + len(chunk))),
-                )
+                if self.serve_batches and chunk:
+                    from storm_tpu.connectors.kafka_protocol import (
+                        encode_record_batch,
+                    )
+
+                    msgset = encode_record_batch(
+                        [(k, v) for k, v, _ in chunk],
+                        int(time.time() * 1e3),
+                        base_offset=offset,
+                    )
+                else:
+                    msgset = encode_message_set(
+                        [(k, v) for k, v, _ in chunk],
+                        int(time.time() * 1e3),
+                        offsets=list(range(offset, offset + len(chunk))),
+                    )
                 w.i32(pid).i16(0).i64(hw)
                 w.bytes_(msgset)
         return bytes(w.buf)

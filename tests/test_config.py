@@ -67,3 +67,13 @@ def test_load_toml(tmp_path):
     cfg = Config.load(p)
     assert cfg.model.name == "vit_b16"
     assert cfg.model.num_classes == 1000
+
+
+def test_broker_config_validates_message_format():
+    from storm_tpu.config import BrokerConfig
+
+    assert BrokerConfig(message_format="v2").message_format == "v2"
+    with pytest.raises(ValueError, match="message_format"):
+        BrokerConfig(message_format="V2")
+    with pytest.raises(ValueError, match="kind"):
+        BrokerConfig(kind="rabbitmq")
