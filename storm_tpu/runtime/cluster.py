@@ -138,6 +138,13 @@ class TopologyRuntime:
             if n:
                 log.warning("%s: %d tuple trees timed out", self.name, n)
             self._supervise()
+            # Backpressure visibility: queued tuples per bolt component
+            # (Storm UI's capacity/queue columns; the autoscaler's other
+            # signal besides latency).
+            for cid, execs in self.bolt_execs.items():
+                self.metrics.gauge(cid, "inbox_depth").set(
+                    sum(e.inbox.qsize() for e in execs)
+                )
 
     def _supervise(self) -> None:
         """Storm-supervisor analog: an executor task that died (bug in

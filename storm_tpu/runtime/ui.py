@@ -14,6 +14,8 @@ Read routes
     GET /api/v1/topology/{name}               health + component table
     GET /api/v1/topology/{name}/metrics       full metrics snapshot
     GET /api/v1/topology/{name}/errors        reported component errors
+    GET /api/v1/topology/{name}/graph         the DAG (components + edges)
+    GET /metrics                              Prometheus text exposition
 
 Admin routes (POST, like Storm UI's topology actions)
     POST /api/v1/topology/{name}/activate
@@ -213,6 +215,13 @@ class UIServer:
                     return 405, {"error": "use GET"}
                 # off-loop: dist-backed health()/snapshot() block on worker RPCs
                 return 200, await asyncio.to_thread(self._topo_detail, rt)
+            if action == "graph":
+                if method != "GET":
+                    return 405, {"error": "use GET"}
+                graph = self._topo_graph(rt)
+                if graph is None:
+                    return 404, {"error": "graph unavailable for this runtime"}
+                return 200, graph
             if action in ("metrics", "errors"):
                 if method != "GET":
                     return 405, {"error": "use GET"}
@@ -274,6 +283,34 @@ class UIServer:
         summary["components"] = comps
         summary["errors"] = len(rt.errors)
         return summary
+
+    def _topo_graph(self, rt) -> Dict[str, Any]:
+        """The topology DAG (Storm UI's visualization data): components with
+        their parallelism and declared streams, edges with groupings."""
+        topo = getattr(rt, "topology", None)
+        if topo is None:
+            return None  # e.g. dist-backed views; the route 404s
+        components, edges = {}, []
+        for spec in topo.specs.values():
+            obj = spec.obj
+            components[spec.component_id] = {
+                "type": "spout" if spec.is_spout else "bolt",
+                "parallelism": spec.parallelism,
+                "streams": {k: list(v)
+                            for k, v in obj.declare_output_fields().items()},
+            }
+            for sub in spec.inputs:
+                edge = {
+                    "from": sub.source,
+                    "stream": sub.stream,
+                    "to": spec.component_id,
+                    "grouping": type(sub.grouping).__name__,
+                }
+                fields = getattr(sub.grouping, "field_names", None)
+                if fields:  # the routing key is the edge's defining info
+                    edge["fields"] = list(fields)
+                edges.append(edge)
+        return {"name": rt.name, "components": components, "edges": edges}
 
     async def _action(self, rt, action: str,
                       args: Dict[str, Any]) -> Tuple[int, Any]:
