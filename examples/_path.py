@@ -1,0 +1,9 @@
+"""Make `storm_tpu` importable when examples run from a checkout
+(``python examples/<script>.py``) without installation."""
+
+import sys
+from pathlib import Path
+
+_root = str(Path(__file__).resolve().parent.parent)
+if _root not in sys.path:
+    sys.path.insert(0, _root)

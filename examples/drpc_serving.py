@@ -1,0 +1,51 @@
+"""Synchronous inference through the streaming runtime (DRPC).
+
+No Kafka anywhere: callers await server.execute("predict", json) and the
+request rides the topology (spout -> micro-batched inference -> return
+bolt). Concurrent calls are batched into one device dispatch.
+
+    python examples/drpc_serving.py
+"""
+
+import asyncio
+import json
+
+import _path  # noqa: F401  (repo-checkout imports)
+
+import numpy as np
+
+from storm_tpu.config import BatchConfig, Config, ModelConfig
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+from storm_tpu.runtime.drpc import DRPCError, DRPCServer, drpc_inference_topology
+
+
+async def main() -> None:
+    server = DRPCServer()
+    topo = drpc_inference_topology(
+        server,
+        ModelConfig(name="lenet5", input_shape=(28, 28, 1), dtype="float32"),
+        BatchConfig(max_batch=16, max_wait_ms=10, buckets=(16,)),
+    )
+    cluster = AsyncLocalCluster()
+    await cluster.submit("serve", Config(), topo)
+
+    rng = np.random.RandomState(0)
+    results = await asyncio.gather(*(
+        server.execute("predict",
+                       json.dumps({"instances": rng.rand(1, 28, 28, 1).tolist()}),
+                       timeout_s=60)
+        for _ in range(8)
+    ))
+    preds = [json.loads(r)["predictions"][0] for r in results]
+    print(f"8 concurrent sync calls -> argmaxes {[int(np.argmax(p)) for p in preds]}")
+
+    try:
+        await server.execute("predict", '{"instances": [[1],[2,3]]}', timeout_s=30)
+    except DRPCError as e:
+        print(f"poison input fails the CALLER (not a timeout): {e}")
+
+    await cluster.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

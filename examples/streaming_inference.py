@@ -1,0 +1,69 @@
+"""The reference topology shape, end to end, in one file.
+
+2x spout -> 4x inference operator -> 2x sink (MainTopology.java:25-28's
+parallelism constants), on the in-process broker, LeNet-5 on whatever JAX
+backend is available. Poison input goes to the dead-letter topic instead
+of the reference's emit-null-and-ack.
+
+    python examples/streaming_inference.py
+"""
+
+import asyncio
+import json
+
+import _path  # noqa: F401  (repo-checkout imports)
+
+import numpy as np
+
+from storm_tpu.config import BatchConfig, Config, ModelConfig
+from storm_tpu.connectors import BrokerSink, BrokerSpout, MemoryBroker
+from storm_tpu.infer import InferenceBolt
+from storm_tpu.runtime import TopologyBuilder
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+
+async def main() -> None:
+    broker = MemoryBroker()
+    cfg = Config()
+
+    tb = TopologyBuilder()
+    tb.set_spout("kafka-spout", BrokerSpout(broker, "input"), parallelism=2)
+    tb.set_bolt(
+        "inference-bolt",
+        InferenceBolt(
+            ModelConfig(name="lenet5", input_shape=(28, 28, 1), dtype="float32"),
+            BatchConfig(max_batch=32, max_wait_ms=20, buckets=(32,)),
+        ),
+        parallelism=4,
+    ).shuffle_grouping("kafka-spout")
+    tb.set_bolt("kafka-bolt", BrokerSink(broker, "output", cfg.sink), parallelism=2)\
+        .shuffle_grouping("inference-bolt")
+    tb.set_bolt("dlq-bolt", BrokerSink(broker, "dead-letter", cfg.sink), parallelism=1)\
+        .shuffle_grouping("inference-bolt", stream="dead_letter")
+
+    cluster = AsyncLocalCluster()
+    rt = await cluster.submit("demo", cfg, tb.build())
+
+    rng = np.random.RandomState(0)
+    for i in range(16):
+        broker.produce("input", json.dumps({"instances": rng.rand(1, 28, 28, 1).tolist()}))
+    broker.produce("input", '{"instances": "not a tensor"}')  # poison
+
+    while broker.topic_size("output") < 16 or broker.topic_size("dead-letter") < 1:
+        await asyncio.sleep(0.1)
+    await rt.drain()
+
+    outs = broker.drain_topic("output")
+    dlq = broker.drain_topic("dead-letter")
+    snap = rt.metrics.snapshot()
+    await cluster.shutdown()
+
+    first = json.loads(outs[0].value)["predictions"][0]
+    print(f"{len(outs)} predictions (first: argmax={int(np.argmax(first))}, "
+          f"p={max(first):.3f}), {len(dlq)} dead-lettered")
+    print(f"e2e p50: {snap['kafka-bolt']['e2e_latency_ms']['p50']:.1f} ms, "
+          f"mean device batch: {snap['inference-bolt']['batch_size']['mean']:.1f}")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -284,7 +284,7 @@ class UIServer:
         summary["errors"] = len(rt.errors)
         return summary
 
-    def _topo_graph(self, rt) -> Dict[str, Any]:
+    def _topo_graph(self, rt) -> Optional[Dict[str, Any]]:
         """The topology DAG (Storm UI's visualization data): components with
         their parallelism and declared streams, edges with groupings."""
         topo = getattr(rt, "topology", None)
