@@ -25,7 +25,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from storm_tpu.config import Config
 from storm_tpu.dist.transport import WorkerClient
@@ -45,6 +45,7 @@ class DistCluster:
         self.procs: List[Optional[subprocess.Popen]] = []
         self.clients: List[WorkerClient] = []
         self._stderr_files: List = []
+        self._stderr_by_index: Dict[int, Any] = {}
         self._env = env
         self._lock = threading.Lock()
         self._monitor: Optional[threading.Thread] = None
@@ -75,6 +76,9 @@ class DistCluster:
         # diagnosable).
         errf = tempfile.TemporaryFile()
         self._stderr_files.append(errf)
+        # current stderr per worker index (recovery replaces the entry;
+        # the flat list above only tracks files for closing)
+        self._stderr_by_index[index] = errf
         proc = subprocess.Popen(
             [sys.executable, "-m", "storm_tpu.dist.worker",
              "--port", "0", "--index", str(index)],
@@ -170,6 +174,25 @@ class DistCluster:
     def health(self) -> Dict[int, dict]:
         return {i: c.control("health")["health"]
                 for i, c in enumerate(self.clients)}
+
+    def worker_logs(self, index: int, tail_bytes: int = 16384) -> str:
+        """Tail of a spawned worker's stderr (the Storm logviewer
+        equivalent). pread leaves the fd offset alone — the file
+        description is shared with the writing child process, so a seek
+        here would corrupt its write position. Locked against
+        recovery/shutdown closing the file mid-read."""
+        tail_bytes = max(1, tail_bytes)
+        with self._lock:
+            f = self._stderr_by_index.get(index)
+            if f is None or self._closing or f.closed:
+                raise KeyError(f"no spawned worker {index} (attached workers "
+                               "keep their own logs)")
+            import os as _os
+
+            fd = f.fileno()
+            size = _os.fstat(fd).st_size
+            start = max(0, size - tail_bytes)
+            return _os.pread(fd, size - start, start).decode("utf-8", "replace")
 
     def rebalance(self, component: str, parallelism: int) -> None:
         """Live parallelism change across the cluster (the reference's
@@ -401,6 +424,7 @@ class DistCluster:
             for f in self._stderr_files:
                 f.close()
             self._stderr_files.clear()
+            self._stderr_by_index.clear()
             self.procs.clear()
             self.clients.clear()
 

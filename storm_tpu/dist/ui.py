@@ -147,6 +147,9 @@ class DistRuntimeView:
     async def rebalance(self, component: str, parallelism: int) -> None:
         await asyncio.to_thread(self._dist.rebalance, component, parallelism)
 
+    async def worker_logs(self, index: int, tail_bytes: int = 16384) -> str:
+        return await asyncio.to_thread(self._dist.worker_logs, index, tail_bytes)
+
     async def kill(self, wait_secs: float = 0.0) -> None:
         await asyncio.to_thread(self._dist.kill, wait_secs)
 

@@ -15,6 +15,8 @@ Read routes
     GET /api/v1/topology/{name}/metrics       full metrics snapshot
     GET /api/v1/topology/{name}/errors        reported component errors
     GET /api/v1/topology/{name}/graph         the DAG (components + edges)
+    GET /api/v1/topology/{name}/logs          dist worker stderr tail
+                                              (?worker=N&bytes=M)
     GET /metrics                              Prometheus text exposition
 
 Admin routes (POST, like Storm UI's topology actions)
@@ -215,6 +217,26 @@ class UIServer:
                     return 405, {"error": "use GET"}
                 # off-loop: dist-backed health()/snapshot() block on worker RPCs
                 return 200, await asyncio.to_thread(self._topo_detail, rt)
+            if action == "logs":
+                if method != "GET":
+                    return 405, {"error": "use GET"}
+                if not hasattr(rt, "worker_logs"):
+                    return 404, {"error": "logs only available for dist "
+                                          "topologies (local runtimes log "
+                                          "to their own stderr)"}
+                try:
+                    widx = int(query.get("worker", 0))
+                    tail = int(query.get("bytes", 16384))
+                except ValueError:
+                    return 400, {"error": "worker and bytes must be ints"}
+                if tail < 1:
+                    return 400, {"error": "bytes must be >= 1"}
+                tail = min(tail, 1 << 20)
+                try:
+                    text = await rt.worker_logs(widx, tail)
+                except KeyError as e:
+                    return 404, {"error": e.args[0] if e.args else str(e)}
+                return 200, {"worker": widx, "log": text}
             if action == "graph":
                 if method != "GET":
                     return 405, {"error": "use GET"}

@@ -111,6 +111,16 @@ def test_dist_ui_status_and_admin(run):
                         None, _http, ui.port, "POST",
                         "/api/v1/topology/dist-ui/activate")
                     assert st == 200
+
+                    # logviewer: each spawned worker's stderr tail
+                    st, logs = await loop.run_in_executor(
+                        None, _http, ui.port, "GET",
+                        "/api/v1/topology/dist-ui/logs?worker=0")
+                    assert st == 200 and isinstance(logs["log"], str)
+                    st, _ = await loop.run_in_executor(
+                        None, _http, ui.port, "GET",
+                        "/api/v1/topology/dist-ui/logs?worker=99")
+                    assert st == 404
                 finally:
                     await ui.stop()
 

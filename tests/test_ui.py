@@ -302,3 +302,50 @@ def test_ui_graph_includes_fields_and_404s_for_viewless(run):
             await ui2.stop()
 
     run(go(), timeout=60)
+
+
+def test_ui_logs_route_404s_for_local_runtime(run):
+    async def go():
+        cluster, ui = await _cluster_with_ui()
+        try:
+            st, r = await _http(ui.port, "GET", "/api/v1/topology/demo/logs")
+            assert st == 404
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
+
+
+def test_ui_logs_negative_bytes_rejected(run):
+    async def go():
+        class HasLogs:
+            name = "x"
+            metrics = None
+            errors = []
+
+            def health(self):
+                return {"components": {}, "inflight_trees": 0}
+
+            def is_active(self):
+                return True
+
+            async def worker_logs(self, index, tail_bytes=16384):
+                return "ok"
+
+        class FakeCluster:
+            runtimes = {"x": HasLogs()}
+
+            def runtime(self, n):
+                return self.runtimes[n]
+
+        ui = await UIServer(FakeCluster(), port=0).start()
+        try:
+            st, _ = await _http(ui.port, "GET", "/api/v1/topology/x/logs?bytes=-1")
+            assert st == 400
+            st, r = await _http(ui.port, "GET", "/api/v1/topology/x/logs?bytes=5")
+            assert st == 200 and r["log"] == "ok"
+        finally:
+            await ui.stop()
+
+    run(go(), timeout=60)
