@@ -1,0 +1,173 @@
+"""Property-based tests (hypothesis) for the framework's algebraic cores:
+the XOR ack ledger, the Kafka varint/record-batch codec, the wire schema,
+and the micro-batcher — invariants that example-based tests undersample."""
+
+import json
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from storm_tpu.runtime.acker import AckLedger
+from storm_tpu.runtime.tuples import new_id
+
+# ---- acker: XOR tuple-tree algebra -------------------------------------------
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    n_edges=st.integers(min_value=1, max_value=40),
+    order=st.randoms(use_true_random=False),
+)
+def test_ledger_completes_iff_every_edge_acked(n_edges, order):
+    """Emit n edges, ack them in ANY order -> exactly one completion, ok."""
+    led = AckLedger(timeout_s=0)
+    done = []
+    root = new_id()
+    led.init_root(root, "m", lambda m, ok, ts: done.append(ok), 0.0)
+    edges = [new_id() for _ in range(n_edges)]
+    for e in edges:
+        led.xor(root, e)  # emit
+    assert led.inflight == 1 and done == []
+    acks = list(edges)
+    order.shuffle(acks)
+    for i, e in enumerate(acks):
+        led.xor(root, e)  # ack
+        if i < len(acks) - 1:
+            assert done == [], "completed before all edges acked"
+    assert done == [True]
+    assert led.inflight == 0
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    n_children=st.integers(min_value=0, max_value=10),
+    fail_at=st.integers(min_value=0, max_value=10),
+)
+def test_ledger_fail_wins_once(n_children, fail_at):
+    """fail_root mid-tree (after fail_at of the acks) -> exactly one
+    callback, ok=False, regardless of how many acks straggle afterwards."""
+    led = AckLedger(timeout_s=0)
+    done = []
+    root = new_id()
+    led.init_root(root, "m", lambda m, ok, ts: done.append(ok), 0.0)
+    edges = [new_id() for _ in range(n_children)]
+    for e in edges:
+        led.xor(root, e)
+    k = min(fail_at, n_children)
+    for e in edges[:k]:
+        led.xor(root, e)  # partial acks before the failure
+    led.fail_root(root)
+    for e in edges[k:]:
+        led.xor(root, e)  # stragglers must be ignored
+    assert done == [False]
+    assert led.inflight == 0
+
+
+# ---- kafka codec -------------------------------------------------------------
+
+
+@settings(max_examples=300, deadline=None)
+@given(st.integers(min_value=-(2**63), max_value=2**63 - 1))
+def test_varint_roundtrip_any_int64(v):
+    from storm_tpu.connectors.kafka_protocol import _read_varint, _write_varint
+
+    buf = bytearray()
+    _write_varint(buf, v)
+    got, pos = _read_varint(bytes(buf), 0)
+    assert got == v and pos == len(buf)
+
+
+_record = st.tuples(
+    st.one_of(st.none(), st.binary(max_size=64)),  # key (nullable)
+    st.binary(max_size=256),  # value
+)
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    records=st.lists(_record, min_size=1, max_size=20),
+    base_offset=st.integers(min_value=0, max_value=2**40),
+    ts_ms=st.integers(min_value=0, max_value=2**41),
+)
+def test_record_batch_roundtrip_any_records(records, base_offset, ts_ms):
+    from storm_tpu.connectors.kafka_protocol import (
+        decode_record_batch,
+        encode_record_batch,
+    )
+
+    batch = encode_record_batch(records, ts_ms=ts_ms, base_offset=base_offset)
+    out, consumed = decode_record_batch("t", 0, batch, verify_crc=True)
+    assert consumed == len(batch)
+    assert [(r.key, r.value) for r in out] == records
+    assert [r.offset for r in out] == list(range(base_offset, base_offset + len(records)))
+
+
+@settings(max_examples=100, deadline=None)
+@given(records=st.lists(_record, min_size=1, max_size=8))
+def test_message_set_v1_roundtrip(records):
+    from storm_tpu.connectors.kafka_protocol import (
+        decode_message_set,
+        encode_message_set,
+    )
+
+    data = encode_message_set(records, ts_ms=1000, offsets=list(range(len(records))))
+    out = decode_message_set("t", 0, data)
+    # v1 sets normalize a None value to b"" on decode; keys survive exactly
+    assert [(r.key, r.value) for r in out] == [(k, v) for k, v in records]
+
+
+# ---- wire schema -------------------------------------------------------------
+
+
+@settings(max_examples=50, deadline=None)
+@given(
+    n=st.integers(min_value=1, max_value=4),
+    h=st.integers(min_value=1, max_value=6),
+    w=st.integers(min_value=1, max_value=6),
+    c=st.integers(min_value=1, max_value=3),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+)
+def test_instances_json_roundtrip(n, h, w, c, seed):
+    from storm_tpu.api.schema import decode_instances
+
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, h, w, c).astype(np.float32)
+    inst = decode_instances(json.dumps({"instances": x.tolist()}))
+    assert inst.data.shape == (n, h, w, c)
+    np.testing.assert_allclose(inst.data, x, rtol=1e-6, atol=1e-7)
+
+
+# ---- micro-batcher -----------------------------------------------------------
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    sizes=st.lists(st.integers(min_value=1, max_value=7), min_size=1, max_size=30),
+    max_batch=st.integers(min_value=4, max_value=32),
+)
+def test_batcher_conserves_records(sizes, max_batch):
+    """Every record added comes back out exactly once, in order, across
+    full-batch pops and the final take_all."""
+    from storm_tpu.config import BatchConfig
+    from storm_tpu.infer.batcher import MicroBatcher
+
+    b = MicroBatcher(BatchConfig(max_batch=max_batch, max_wait_ms=1e9,
+                                 buckets=(max_batch,)))
+    seen = []
+    idx = 0
+    for size in sizes:
+        data = np.full((size, 2), idx, np.float32)
+        batch = b.add(idx, data, ts=0.0)
+        idx += 1
+        if batch is not None:
+            for payload, rows in zip([i.payload for i in batch.items],
+                                     [i.data for i in batch.items]):
+                seen.append((payload, rows.shape[0]))
+    final = b.take_all()
+    if final is not None:
+        for item in final.items:
+            seen.append((item.payload, item.data.shape[0]))
+    assert [p for p, _ in seen] == list(range(len(sizes)))
+    assert [s for _, s in seen] == sizes
+    assert len(b) == 0
