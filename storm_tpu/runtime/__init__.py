@@ -8,9 +8,11 @@ from storm_tpu.runtime.state import (
     MemoryStateBackend,
     StatefulBolt,
 )
+from storm_tpu.runtime.join import JoinBolt
 from storm_tpu.runtime.window import TumblingWindowBolt, WindowedBolt
 
 __all__ = [
+    "JoinBolt",
     "WindowedBolt",
     "TumblingWindowBolt",
     "StatefulBolt",

@@ -1,0 +1,154 @@
+"""Windowed stream joins (runtime/join.py) — Storm's JoinBolt equivalent:
+inner/left key joins across source components within a window."""
+
+import asyncio
+
+import pytest
+
+from storm_tpu.config import Config
+from storm_tpu.runtime import Bolt, JoinBolt, Spout, TopologyBuilder, Values
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+
+class RowSpout(Spout):
+    """Emits predeclared (fields, rows) once."""
+
+    def __init__(self, fields, rows):
+        self.fields = tuple(fields)
+        self.rows = [tuple(r) for r in rows]
+
+    def clone(self):
+        return RowSpout(self.fields, self.rows)
+
+    def declare_output_fields(self):
+        return {"default": self.fields}
+
+    def open(self, context, collector):
+        super().open(context, collector)
+        self.queue = list(self.rows) if context.task_index == 0 else []
+        self.acked, self.failed = [], []
+
+    async def next_tuple(self):
+        if not self.queue:
+            return False
+        row = self.queue.pop(0)
+        await self.collector.emit(Values(list(row)), msg_id=row)
+        return True
+
+    def ack(self, msg_id):
+        self.acked.append(msg_id)
+
+    def fail(self, msg_id):
+        self.failed.append(msg_id)
+
+
+class CollectRows(Bolt):
+    rows = None
+
+    def prepare(self, context, collector):
+        super().prepare(context, collector)
+        if CollectRows.rows is None:
+            CollectRows.rows = []
+
+    async def execute(self, t):
+        CollectRows.rows.append(tuple(t.values))
+        self.collector.ack(t)
+
+
+async def _run_join(orders, payments, how,
+                    select=("user", "orders.amount", "payments.method")):
+    CollectRows.rows = None
+    want = len(orders) + len(payments)
+    tb = TopologyBuilder()
+    tb.set_spout("orders", RowSpout(("user", "amount"), orders), 1)
+    tb.set_spout("payments", RowSpout(("user", "method"), payments), 1)
+    tb.set_bolt(
+        "join",
+        # window sized to the input: fires once everything has arrived
+        JoinBolt(on="user", streams=["orders", "payments"], select=list(select),
+                 how=how, window_count=want),
+        1,
+    ).fields_grouping("orders", "user").fields_grouping("payments", "user")
+    tb.set_bolt("collect", CollectRows(), 1).shuffle_grouping("join")
+
+    cfg = Config()
+    cfg.topology.message_timeout_s = 300.0  # the sweep must not race slow CI
+    cluster = AsyncLocalCluster()
+    rt = await cluster.submit("join", cfg, tb.build())
+    o = rt.spout_execs["orders"][0].spout
+    p = rt.spout_execs["payments"][0].spout
+    deadline = asyncio.get_event_loop().time() + 30
+    while asyncio.get_event_loop().time() < deadline:
+        if len(o.acked) + len(o.failed) + len(p.acked) + len(p.failed) >= want:
+            break
+        await asyncio.sleep(0.02)
+    await rt.kill(wait_secs=10)
+    rows = list(CollectRows.rows or [])
+    acked = (list(o.acked), list(p.acked))
+    await cluster.shutdown()
+    return rows, acked
+
+
+def test_inner_join_matches_keys(run):
+    rows, (o_acked, p_acked) = run(_run_join(
+        orders=[("alice", 30), ("bob", 99), ("carol", 7)],
+        payments=[("alice", "card"), ("carol", "cash")],
+        how="inner",
+    ), timeout=60)
+    assert sorted(rows) == [("alice", 30, "card"), ("carol", 7, "cash")]
+    # bob's order had no payment: inner join drops it, tuple still acked
+    assert len(o_acked) == 3 and len(p_acked) == 2
+
+
+def test_left_join_pads_missing(run):
+    rows, _ = run(_run_join(
+        orders=[("alice", 30), ("bob", 99)],
+        payments=[("alice", "card")],
+        how="left",
+    ), timeout=60)
+    assert sorted(rows, key=str) == [("alice", 30, "card"), ("bob", 99, None)]
+
+
+def test_join_cartesian_per_key(run):
+    rows, _ = run(_run_join(
+        orders=[("alice", 1), ("alice", 2)],
+        payments=[("alice", "card"), ("alice", "cash")],
+        how="inner",
+    ), timeout=60)
+    assert len(rows) == 4  # 2 orders x 2 payments for the key
+    assert {r[1] for r in rows} == {1, 2} and {r[2] for r in rows} == {"card", "cash"}
+
+
+def test_join_select_bare_field_first_stream_wins(run):
+    rows, _ = run(_run_join(
+        orders=[("alice", 5)],
+        payments=[("alice", "card")],
+        how="inner",
+        select=("user", "amount", "method"),
+    ), timeout=60)
+    assert rows == [("alice", 5, "card")]
+
+
+def test_join_validation():
+    with pytest.raises(ValueError, match="two streams"):
+        JoinBolt(on="k", streams=["only"], select=["k"], window_count=4)
+    with pytest.raises(ValueError, match="inner|left"):
+        JoinBolt(on="k", streams=["a", "b"], select=["k"], how="outer",
+                 window_count=4)
+
+
+def test_left_join_keeps_unkeyed_first_stream_rows(run):
+    rows, _ = run(_run_join(
+        orders=[(None, 42), ("alice", 1)],
+        payments=[("alice", "card")],
+        how="left",
+    ), timeout=60)
+    assert set(rows) == {(None, 42, None), ("alice", 1, "card")}
+
+
+def test_join_select_typo_rejected():
+    with pytest.raises(ValueError, match="unknown stream"):
+        JoinBolt(on="k", streams=["a", "b"], select=["a.x", "c.y"],
+                 window_count=4)
+    with pytest.raises(ValueError, match="duplicate stream"):
+        JoinBolt(on="k", streams=["a", "a"], select=["k"], window_count=4)
