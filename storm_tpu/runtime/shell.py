@@ -1,0 +1,227 @@
+"""Multilang components — Storm's ShellBolt protocol, asyncio-native.
+
+storm-core lets a bolt be ANY executable speaking newline-JSON over
+stdio (the multilang protocol behind storm.py/storm.rb/storm.js et al).
+Same contract here:
+
+- messages are one JSON object followed by a line containing ``end``;
+- handshake: the host sends ``{"conf": .., "context": .., "pidDir": ..}``,
+  the child answers ``{"pid": N}``;
+- tuples go down as ``{"id", "comp", "stream", "task", "tuple"}``; the
+  child answers with ``{"command": "emit"|"ack"|"fail"|"log", ...}``;
+- heartbeat tuples ride the ``__heartbeat__`` stream; the child must
+  answer ``{"command": "sync"}`` — a wedged child fails its pending
+  tuples and is restarted by the executor's normal supervision.
+
+The child side for Python lives in :mod:`storm_tpu.multilang` (the
+``storm.py`` equivalent); any language can implement the same framing.
+
+Processing is asynchronous, like Storm's ShellBolt: ``execute`` ships the
+tuple and returns; the reader task routes the child's acks/fails/emits
+back through the collector whenever they arrive. Emitted tuples anchor to
+the child's ``anchors`` ids (defaulting to nothing), so tuple-tree
+semantics survive the process boundary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from storm_tpu.runtime.base import Bolt, OutputCollector, TopologyContext
+from storm_tpu.runtime.tuples import Tuple, Values, new_id
+
+log = logging.getLogger("storm_tpu.shell")
+
+
+class ShellBolt(Bolt):
+    """Run a subprocess component over the multilang protocol.
+
+    ``ShellBolt("python", "my_bolt.py")`` — the command is executed once
+    per task; output fields default to ``("message",)`` unless
+    ``output_fields`` says otherwise."""
+
+    def __init__(self, *command: str,
+                 output_fields: tuple = ("message",),
+                 heartbeat_s: float = 10.0) -> None:
+        if not command:
+            raise ValueError("ShellBolt needs a command")
+        self.command = tuple(command)
+        self.output_fields = tuple(output_fields)
+        self.heartbeat_s = heartbeat_s
+
+    def clone(self) -> "ShellBolt":
+        return ShellBolt(*self.command, output_fields=self.output_fields,
+                         heartbeat_s=self.heartbeat_s)
+
+    def declare_output_fields(self):
+        return {"default": self.output_fields}
+
+    def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
+        super().prepare(context, collector)
+        self._proc: Optional[asyncio.subprocess.Process] = None
+        self._pending: Dict[str, Tuple] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._hb_task: Optional[asyncio.Task] = None
+        self._last_reply = time.monotonic()
+
+    # ---- protocol plumbing ---------------------------------------------------
+
+    async def _send(self, obj: Dict[str, Any]) -> None:
+        self._proc.stdin.write(json.dumps(obj).encode() + b"\nend\n")
+        await self._proc.stdin.drain()
+
+    async def _read_msg(self) -> Optional[Dict[str, Any]]:
+        lines: List[bytes] = []
+        while True:
+            line = await self._proc.stdout.readline()
+            if not line:
+                return None  # child exited
+            if line.strip() == b"end":
+                break
+            lines.append(line)
+        try:
+            return json.loads(b"".join(lines))
+        except ValueError:
+            raise RuntimeError(
+                f"shell component sent non-JSON: {b''.join(lines)[:200]!r}")
+
+    async def _start(self) -> None:
+        self._proc = await asyncio.create_subprocess_exec(
+            *self.command,
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+        )
+        ctx = self.context
+        await self._send({
+            "conf": {"topology.name": getattr(ctx.config, "topology", None)
+                     and ctx.config.topology.name},
+            "pidDir": tempfile.gettempdir(),
+            "context": {
+                "componentid": ctx.component_id,
+                "taskid": ctx.task_index,
+                "parallelism": ctx.parallelism,
+            },
+        })
+        hello = await self._read_msg()
+        if hello is None or "pid" not in hello:
+            raise RuntimeError(
+                f"shell component {self.command} failed the handshake: {hello}")
+        self._last_reply = time.monotonic()
+        self._reader_task = asyncio.get_running_loop().create_task(self._reader())
+        if self.heartbeat_s > 0:
+            self._hb_task = asyncio.get_running_loop().create_task(
+                self._heartbeats())
+
+    def _child_gone(self) -> None:
+        """Fail in-flight tuples and mark the child for respawn: the next
+        execute() starts a fresh process (executor supervision only replaces
+        bolts whose asyncio task dies, which a caught child crash is not)."""
+        for t in list(self._pending.values()):
+            self.collector.fail(t)
+        self._pending.clear()
+        if self._proc is not None and self._proc.returncode is None:
+            self._proc.kill()
+        self._proc = None
+
+    async def _reader(self) -> None:
+        try:
+            while True:
+                msg = await self._read_msg()
+                if msg is None:
+                    self._child_gone()  # child died -> tuples replay
+                    return
+                self._last_reply = time.monotonic()
+                cmd = msg.get("command")
+                if cmd == "ack":
+                    t = self._pending.pop(str(msg.get("id")), None)
+                    if t is not None:
+                        self.collector.ack(t)
+                elif cmd == "fail":
+                    t = self._pending.pop(str(msg.get("id")), None)
+                    if t is not None:
+                        self.collector.fail(t)
+                elif cmd == "emit":
+                    anchors = [self._pending[str(a)]
+                               for a in msg.get("anchors", [])
+                               if str(a) in self._pending]
+                    await self.collector.emit(
+                        Values(list(msg.get("tuple", []))),
+                        stream=msg.get("stream") or "default",
+                        anchors=anchors,
+                    )
+                    if msg.get("need_task_ids", True):
+                        # Storm replies with a bare JSON array of task ids
+                        self._proc.stdin.write(b"[0]\nend\n")
+                        await self._proc.stdin.drain()
+                elif cmd == "log":
+                    log.info("[%s/%s] %s", self.context.component_id,
+                             self.context.task_index, msg.get("msg"))
+                elif cmd == "sync":
+                    pass  # heartbeat reply; _last_reply already bumped
+                else:
+                    log.warning("unknown shell command %r", cmd)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # framing corruption (stray child output) must be loud: report,
+            # fail in-flight, respawn on next tuple — never a silent hang
+            self.collector.report_error(e)
+            self._child_gone()
+
+    async def _heartbeats(self) -> None:
+        while True:
+            await asyncio.sleep(self.heartbeat_s)
+            if time.monotonic() - self._last_reply > 2 * self.heartbeat_s:
+                # wedged child: fail in-flight tuples; the next execute()
+                # respawns a fresh process
+                log.error("shell component %s unresponsive; failing %d tuples",
+                          self.command, len(self._pending))
+                self._child_gone()
+                return
+            try:
+                await self._send({"id": new_id(), "comp": None,
+                                  "stream": "__heartbeat__", "task": -1,
+                                  "tuple": []})
+            except (ConnectionError, BrokenPipeError):
+                return
+
+    # ---- bolt surface --------------------------------------------------------
+
+    async def execute(self, t: Tuple) -> None:
+        if self._proc is None or self._proc.returncode is not None:
+            if self._hb_task is not None:
+                self._hb_task.cancel()
+            await self._start()
+        tid = str(new_id())
+        self._pending[tid] = t
+        await self._send({
+            "id": tid,
+            "comp": t.source_component,
+            "stream": t.stream,
+            "task": t.source_task,
+            "tuple": list(t.values),
+        })
+
+    async def flush(self) -> None:
+        deadline = time.monotonic() + 10
+        while self._pending and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+
+    def cleanup(self) -> None:
+        for task in (self._reader_task, self._hb_task):
+            if task is not None:
+                task.cancel()
+        if self._proc is not None and self._proc.returncode is None:
+            self._proc.kill()
+            # reap asynchronously so the transport closes cleanly (cleanup
+            # is sync; an unawaited child leaves a ResourceWarning)
+            try:
+                loop = asyncio.get_event_loop()
+                self._reaper = loop.create_task(self._proc.wait())
+            except RuntimeError:
+                pass  # no loop: interpreter shutdown

@@ -1,0 +1,156 @@
+"""Multilang ShellBolt (runtime/shell.py + storm_tpu/multilang.py):
+subprocess components over Storm's newline-JSON stdio protocol."""
+
+import asyncio
+import sys
+import textwrap
+
+import pytest
+
+from storm_tpu.config import Config
+from storm_tpu.runtime import Bolt, ShellBolt, TopologyBuilder
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+from tests.test_runtime import ListSpout
+
+
+def _component(tmp_path, body):
+    import pathlib
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    body_lines = textwrap.dedent(body).strip().splitlines()
+    script = tmp_path / "component.py"
+    script.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from storm_tpu.multilang import ShellComponent\n\n"
+        "class C(ShellComponent):\n"
+        "    def process(self, tup):\n"
+        + "\n".join("        " + l for l in body_lines)
+        + "\n\nC().run()\n"
+    )
+    return str(script)
+
+
+class Collect(Bolt):
+    got = None
+
+    def prepare(self, context, collector):
+        super().prepare(context, collector)
+        if Collect.got is None:
+            Collect.got = []
+
+    async def execute(self, t):
+        Collect.got.append(t.values[0])
+        self.collector.ack(t)
+
+
+async def _run_shell(tmp_path, body, items, heartbeat_s=10.0, timeout=30.0,
+                     replay=False):
+    Collect.got = None
+    script = _component(tmp_path, body)
+    tb = TopologyBuilder()
+    spout = ListSpout(items, replay_on_fail=replay)
+    tb.set_spout("s", spout, 1)
+    tb.set_bolt("shell", ShellBolt(sys.executable, script,
+                                   heartbeat_s=heartbeat_s), 1)\
+        .shuffle_grouping("s")
+    tb.set_bolt("collect", Collect(), 1).shuffle_grouping("shell")
+    cfg = Config()
+    cfg.topology.message_timeout_s = 300.0
+    cluster = AsyncLocalCluster()
+    rt = await cluster.submit("shell", cfg, tb.build())
+    live = rt.spout_execs["s"][0].spout
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        done = len(live.acked) + (0 if replay else len(live.failed))
+        if done >= len(items):
+            break
+        await asyncio.sleep(0.02)
+    res = (list(Collect.got or []), list(live.acked), list(live.failed))
+    await cluster.shutdown()
+    return res
+
+
+def test_shell_bolt_emits_and_acks(run, tmp_path):
+    got, acked, failed = run(_run_shell(
+        tmp_path,
+        """
+        self.emit([tup["tuple"][0] * 2], anchors=[tup["id"]])
+        self.ack(tup["id"])
+        """,
+        [1, 2, 3, 4],
+    ), timeout=60)
+    assert sorted(got) == [2, 4, 6, 8]
+    assert len(acked) == 4 and failed == []
+
+
+def test_shell_bolt_fail_propagates(run, tmp_path):
+    got, acked, failed = run(_run_shell(
+        tmp_path,
+        """
+        if tup["tuple"][0] == 2:
+            self.fail(tup["id"])
+        else:
+            self.emit([tup["tuple"][0]], anchors=[tup["id"]])
+            self.ack(tup["id"])
+        """,
+        [1, 2, 3],
+    ), timeout=60)
+    assert sorted(got) == [1, 3]
+    assert sorted(failed) == [2]
+
+
+def test_shell_bolt_child_death_fails_inflight(run, tmp_path):
+    # the component dies on the second tuple WITHOUT acking the first
+    got, acked, failed = run(_run_shell(
+        tmp_path,
+        """
+        import os
+        if tup["tuple"][0] == "die":
+            os._exit(1)
+        # never ack: tuples stay pending until the child dies
+        """,
+        ["a", "die"],
+    ), timeout=60)
+    assert acked == []
+    assert set(failed) == {"a", "die"}
+
+
+def test_shell_component_validation():
+    with pytest.raises(ValueError):
+        ShellBolt()
+
+
+def test_shell_bolt_respawns_after_child_death(run, tmp_path):
+    """A dead child is replaced on the next tuple: replays make progress
+    instead of looping against a permanently-broken task."""
+    marker = tmp_path / "died_once"
+    got, acked, failed = run(_run_shell(
+        tmp_path,
+        f"""
+        import os
+        if tup["tuple"][0] == "boom" and not os.path.exists({str(marker)!r}):
+            open({str(marker)!r}, "w").close()
+            os._exit(1)
+        self.emit([tup["tuple"][0]], anchors=[tup["id"]])
+        self.ack(tup["id"])
+        """,
+        ["boom"],
+        replay=True,
+    ), timeout=60)
+    assert got == ["boom"]  # replayed into a FRESH child and processed
+    assert acked == ["boom"]
+
+
+def test_shell_user_print_does_not_corrupt_protocol(run, tmp_path):
+    got, acked, failed = run(_run_shell(
+        tmp_path,
+        """
+        print("debugging", tup["tuple"][0])  # must go to stderr, not framing
+        self.emit([tup["tuple"][0] + 1], anchors=[tup["id"]])
+        self.ack(tup["id"])
+        """,
+        [10, 20],
+    ), timeout=60)
+    assert sorted(got) == [11, 21]
+    assert len(acked) == 2 and failed == []
