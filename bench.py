@@ -42,6 +42,12 @@ CONFIGS = {
                      bolts=4, max_batch=64, buckets=(16, 64), metric="imagenet_resnet50"),
     "vit_b16": dict(model="vit_b16", input_shape=(224, 224, 3), num_classes=1000,
                     bolts=4, max_batch=64, buckets=(16, 64), metric="imagenet_vit_b16"),
+    "mobilenetv2": dict(model="mobilenetv2", input_shape=(32, 32, 3), num_classes=10,
+                        bolts=4, max_batch=512, buckets=(64, 512),
+                        metric="cifar10_mobilenetv2"),
+    "mixer_tiny": dict(model="mixer_tiny", input_shape=(32, 32, 3), num_classes=10,
+                       bolts=4, max_batch=512, buckets=(64, 512),
+                       metric="cifar10_mixer_tiny"),
     # BASELINE.json config 5: MNIST+CIFAR pipelines sharing one slice.
     # Dispatches to run_multi() — the dict here only carries the metric name.
     "multi": dict(metric="multi_mnist_cifar"),
