@@ -60,7 +60,7 @@ class ShellComponent:
     # ---- component surface ---------------------------------------------------
 
     def emit(self, values: List[Any], anchors: Optional[List[str]] = None,
-             stream: Optional[str] = None) -> None:
+             stream: Optional[str] = None, id: Optional[str] = None) -> None:
         msg: Dict[str, Any] = {
             "command": "emit",
             "tuple": list(values),
@@ -70,6 +70,8 @@ class ShellComponent:
             msg["anchors"] = list(anchors)
         if stream:
             msg["stream"] = stream
+        if id is not None:
+            msg["id"] = id  # spout emits: at-least-once tracking id
         self._send(msg)
 
     def ack(self, tuple_id: str) -> None:
@@ -95,3 +97,32 @@ class ShellComponent:
                 self._send({"command": "sync"})
                 continue
             self.process(tup)
+
+
+class ShellSpoutComponent(ShellComponent):
+    """Child-side SOURCE: override ``next`` (emit zero or more tuples with
+    ids), ``on_ack``/``on_fail`` for replay policy. The host drives the
+    next/ack/fail cycle; each cycle ends with the automatic ``sync``."""
+
+    def next(self) -> None:
+        raise NotImplementedError
+
+    def on_ack(self, tuple_id: str) -> None:
+        pass
+
+    def on_fail(self, tuple_id: str) -> None:
+        pass
+
+    def run(self) -> None:
+        while True:
+            msg = self._read()
+            if isinstance(msg, list):
+                continue  # bare task-ids reply
+            cmd = msg.get("command")
+            if cmd == "next":
+                self.next()
+            elif cmd == "ack":
+                self.on_ack(msg.get("id"))
+            elif cmd == "fail":
+                self.on_fail(msg.get("id"))
+            self._send({"command": "sync"})

@@ -9,12 +9,13 @@ from storm_tpu.runtime.state import (
     StatefulBolt,
 )
 from storm_tpu.runtime.join import JoinBolt
-from storm_tpu.runtime.shell import ShellBolt
+from storm_tpu.runtime.shell import ShellBolt, ShellSpout
 from storm_tpu.runtime.window import TumblingWindowBolt, WindowedBolt
 
 __all__ = [
     "JoinBolt",
     "ShellBolt",
+    "ShellSpout",
     "WindowedBolt",
     "TumblingWindowBolt",
     "StatefulBolt",

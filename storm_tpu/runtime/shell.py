@@ -32,13 +32,61 @@ import tempfile
 import time
 from typing import Any, Dict, List, Optional
 
-from storm_tpu.runtime.base import Bolt, OutputCollector, TopologyContext
+from storm_tpu.runtime.base import Bolt, OutputCollector, Spout, TopologyContext
 from storm_tpu.runtime.tuples import Tuple, Values, new_id
 
 log = logging.getLogger("storm_tpu.shell")
 
 
-class ShellBolt(Bolt):
+class _ShellProtocol:
+    """Shared multilang framing: spawn + handshake, newline-JSON send, and
+    end-terminated reads — one copy for bolt and spout hosts."""
+
+    command: tuple
+    _proc: Optional[asyncio.subprocess.Process]
+
+    async def _send(self, obj: Dict[str, Any]) -> None:
+        self._proc.stdin.write(json.dumps(obj).encode() + b"\nend\n")
+        await self._proc.stdin.drain()
+
+    async def _read_msg(self) -> Optional[Dict[str, Any]]:
+        lines: List[bytes] = []
+        while True:
+            line = await self._proc.stdout.readline()
+            if not line:
+                return None  # child exited
+            if line.strip() == b"end":
+                break
+            lines.append(line)
+        try:
+            return json.loads(b"".join(lines))
+        except ValueError:
+            raise RuntimeError(
+                f"shell component sent non-JSON: {b''.join(lines)[:200]!r}")
+
+    async def _spawn(self, conf: Dict[str, Any]) -> None:
+        self._proc = await asyncio.create_subprocess_exec(
+            *self.command,
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+        )
+        ctx = self.context
+        await self._send({
+            "conf": conf,
+            "pidDir": tempfile.gettempdir(),
+            "context": {
+                "componentid": ctx.component_id,
+                "taskid": ctx.task_index,
+                "parallelism": ctx.parallelism,
+            },
+        })
+        hello = await self._read_msg()
+        if hello is None or "pid" not in hello:
+            raise RuntimeError(
+                f"shell component {self.command} failed the handshake: {hello}")
+
+
+class ShellBolt(_ShellProtocol, Bolt):
     """Run a subprocess component over the multilang protocol.
 
     ``ShellBolt("python", "my_bolt.py")`` — the command is executed once
@@ -71,46 +119,10 @@ class ShellBolt(Bolt):
 
     # ---- protocol plumbing ---------------------------------------------------
 
-    async def _send(self, obj: Dict[str, Any]) -> None:
-        self._proc.stdin.write(json.dumps(obj).encode() + b"\nend\n")
-        await self._proc.stdin.drain()
-
-    async def _read_msg(self) -> Optional[Dict[str, Any]]:
-        lines: List[bytes] = []
-        while True:
-            line = await self._proc.stdout.readline()
-            if not line:
-                return None  # child exited
-            if line.strip() == b"end":
-                break
-            lines.append(line)
-        try:
-            return json.loads(b"".join(lines))
-        except ValueError:
-            raise RuntimeError(
-                f"shell component sent non-JSON: {b''.join(lines)[:200]!r}")
-
     async def _start(self) -> None:
-        self._proc = await asyncio.create_subprocess_exec(
-            *self.command,
-            stdin=asyncio.subprocess.PIPE,
-            stdout=asyncio.subprocess.PIPE,
-        )
         ctx = self.context
-        await self._send({
-            "conf": {"topology.name": getattr(ctx.config, "topology", None)
-                     and ctx.config.topology.name},
-            "pidDir": tempfile.gettempdir(),
-            "context": {
-                "componentid": ctx.component_id,
-                "taskid": ctx.task_index,
-                "parallelism": ctx.parallelism,
-            },
-        })
-        hello = await self._read_msg()
-        if hello is None or "pid" not in hello:
-            raise RuntimeError(
-                f"shell component {self.command} failed the handshake: {hello}")
+        await self._spawn({"topology.name": getattr(ctx.config, "topology", None)
+                           and ctx.config.topology.name})
         self._last_reply = time.monotonic()
         self._reader_task = asyncio.get_running_loop().create_task(self._reader())
         if self.heartbeat_s > 0:
@@ -225,3 +237,115 @@ class ShellBolt(Bolt):
                 self._reaper = loop.create_task(self._proc.wait())
             except RuntimeError:
                 pass  # no loop: interpreter shutdown
+
+
+class ShellSpout(_ShellProtocol, Spout):
+    """Run a subprocess SOURCE over the multilang protocol (Storm's
+    ShellSpout): the host sends ``{"command": "next"}`` / ``ack`` / ``fail``
+    control messages; the child replies with zero or more ``emit`` commands
+    followed by ``{"command": "sync"}``.
+
+    Child emits carry their own ``id`` for at-least-once tracking; acks and
+    fails are forwarded back into the child, which owns replay policy
+    (exactly Storm's contract)."""
+
+    def __init__(self, *command: str,
+                 output_fields: tuple = ("message",),
+                 drive_timeout_s: float = 30.0) -> None:
+        if not command:
+            raise ValueError("ShellSpout needs a command")
+        self.command = tuple(command)
+        self.output_fields = tuple(output_fields)
+        self.drive_timeout_s = drive_timeout_s
+
+    def clone(self) -> "ShellSpout":
+        return ShellSpout(*self.command, output_fields=self.output_fields,
+                          drive_timeout_s=self.drive_timeout_s)
+
+    def declare_output_fields(self):
+        return {"default": self.output_fields}
+
+    def open(self, context: TopologyContext, collector: OutputCollector) -> None:
+        super().open(context, collector)
+        self._proc: Optional[asyncio.subprocess.Process] = None
+        self._closed = False
+        # next/ack/fail each do a full request->sync round trip on one
+        # pipe; interleaving them would cross-read replies
+        self._drive_lock = asyncio.Lock()
+
+    async def _drive(self, command: Dict[str, Any]) -> int:
+        """Send one control command; emit until the child syncs.
+
+        A wedged child (no sync within drive_timeout_s), a dead pipe, or
+        framing corruption kills the child and resets for respawn on the
+        next drive — reported, never a silent desync."""
+        async with self._drive_lock:
+            if self._closed:
+                return 0
+            try:
+                return await asyncio.wait_for(
+                    self._drive_locked(command), self.drive_timeout_s)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.collector.report_error(e)
+                if self._proc is not None:
+                    if self._proc.returncode is None:
+                        self._proc.kill()
+                    self._proc = None
+                return 0
+
+    async def _drive_locked(self, command: Dict[str, Any]) -> int:
+        if self._proc is None or self._proc.returncode is not None:
+            await self._spawn({})
+        await self._send(command)
+        emitted = 0
+        while True:
+            msg = await self._read_msg()
+            if msg is None:
+                self._proc = None  # child died; respawn on next drive
+                return emitted
+            cmd = msg.get("command")
+            if cmd == "sync":
+                return emitted
+            if cmd == "emit":
+                await self.collector.emit(
+                    Values(list(msg.get("tuple", []))),
+                    stream=msg.get("stream") or "default",
+                    msg_id=msg.get("id"),
+                )
+                emitted += 1
+                if msg.get("need_task_ids", True):
+                    self._proc.stdin.write(b"[0]\nend\n")
+                    await self._proc.stdin.drain()
+            elif cmd == "log":
+                log.info("[%s/%s] %s", self.context.component_id,
+                         self.context.task_index, msg.get("msg"))
+            else:
+                log.warning("unknown shell spout command %r", cmd)
+
+    async def next_tuple(self) -> bool:
+        return await self._drive({"command": "next"}) > 0
+
+    def ack(self, msg_id: Any) -> None:
+        self._bg(self._drive({"command": "ack", "id": msg_id}))
+
+    def fail(self, msg_id: Any) -> None:
+        self._bg(self._drive({"command": "fail", "id": msg_id}))
+
+    def _bg(self, coro) -> None:
+        # ack/fail are sync spout callbacks; the protocol round trip runs
+        # as a task (strong ref kept: create_task results are weak)
+        if not hasattr(self, "_bg_tasks"):
+            self._bg_tasks = set()
+        task = asyncio.get_event_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
+    def close(self) -> None:
+        self._closed = True  # queued ack/fail drives must not respawn
+        if hasattr(self, "_bg_tasks"):
+            for task in list(self._bg_tasks):
+                task.cancel()
+        if self._proc is not None and self._proc.returncode is None:
+            self._proc.kill()

@@ -154,3 +154,68 @@ def test_shell_user_print_does_not_corrupt_protocol(run, tmp_path):
     ), timeout=60)
     assert sorted(got) == [11, 21]
     assert len(acked) == 2 and failed == []
+
+
+def _spout_component(tmp_path, body):
+    import pathlib
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    body_lines = textwrap.dedent(body).strip().splitlines()
+    script = tmp_path / "spout_component.py"
+    script.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from storm_tpu.multilang import ShellSpoutComponent\n\n"
+        "class S(ShellSpoutComponent):\n"
+        + "\n".join("    " + l for l in body_lines)
+        + "\n\nS().run()\n"
+    )
+    return str(script)
+
+
+def test_shell_spout_emits_and_sees_acks(run, tmp_path):
+    from storm_tpu.runtime import ShellSpout
+
+    Collect.got = None
+    ack_file = tmp_path / "acks.txt"
+    script = _spout_component(tmp_path, f"""
+        items = ["a", "b", "c"]
+
+        def next(self):
+            if self.items:
+                item = self.items.pop(0)
+                self.emit([item], id=item)
+
+        def on_ack(self, tid):
+            with open({str(ack_file)!r}, "a") as f:
+                f.write(tid + chr(10))
+    """)
+
+    async def go():
+        import sys as _sys
+
+        tb = TopologyBuilder()
+        tb.set_spout("src", ShellSpout(_sys.executable, script), 1)
+        tb.set_bolt("collect", Collect(), 1).shuffle_grouping("src")
+        cfg = Config()
+        cfg.topology.message_timeout_s = 300.0
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("sspout", cfg, tb.build())
+        deadline = asyncio.get_event_loop().time() + 30
+        while asyncio.get_event_loop().time() < deadline:
+            if len(Collect.got or []) >= 3 and rt.ledger.inflight == 0:
+                break
+            await asyncio.sleep(0.05)
+        # wait for the ack round trips to land IN THE CHILD
+        deadline = asyncio.get_event_loop().time() + 20
+        while asyncio.get_event_loop().time() < deadline:
+            if ack_file.exists() and len(ack_file.read_text().split()) >= 3:
+                break
+            await asyncio.sleep(0.05)
+        got = list(Collect.got or [])
+        await cluster.shutdown()
+        assert sorted(got) == ["a", "b", "c"]
+        # the ack/fail forwarding path delivered to the child's on_ack
+        assert sorted(ack_file.read_text().split()) == ["a", "b", "c"]
+
+    run(go(), timeout=60)
