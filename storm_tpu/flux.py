@@ -1,0 +1,182 @@
+"""Declarative topologies — the Storm Flux equivalent.
+
+Storm's Flux subproject defines topologies in YAML (component classes,
+constructor args, parallelism, groupings) so wiring changes don't need a
+rebuild. Same idea here, over TOML/JSON and the Python class path space::
+
+    [topology]
+    name = "wordcount"
+
+    [resources.broker]
+    class = "storm_tpu.connectors.memory.MemoryBroker"
+
+    [[spouts]]
+    id = "spout"
+    class = "storm_tpu.connectors.spout.BrokerSpout"
+    parallelism = 2
+    args = { broker = "$broker", topic = "input" }
+
+    [[bolts]]
+    id = "infer"
+    class = "storm_tpu.infer.operator.InferenceBolt"
+    parallelism = 4
+    groupings = [ { source = "spout", type = "shuffle" } ]
+
+    [[bolts]]
+    id = "sink"
+    class = "storm_tpu.connectors.sink.BrokerSink"
+    args = { broker = "$broker", topic = "output" }
+    groupings = [ { source = "infer", type = "fields", fields = ["message"] } ]
+
+- ``class`` is a dotted import path; ``args``/``kwargs`` feed the
+  constructor. A string value ``"$name"`` resolves from the ``resources``
+  section (constructed once, shared — brokers, DRPC servers, engines), or
+  from the ``resources=`` dict passed by the caller (which wins, letting
+  tests inject in-process fakes).
+- nested ``{ class = ..., args = ... }`` tables construct nested objects
+  (e.g. a ``ModelConfig`` inside an ``InferenceBolt``).
+- grouping ``type``: shuffle | local_or_shuffle | fields (+``fields``) |
+  all | global | direct, optional ``stream``.
+
+``load_topology(path_or_dict, resources=...)`` returns the built
+:class:`~storm_tpu.runtime.topology.Topology`; the ``run`` CLI accepts
+``--topology-file``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+from typing import Any, Dict, Optional
+
+from storm_tpu.runtime.topology import Topology, TopologyBuilder
+
+_GROUPINGS = {"shuffle", "local_or_shuffle", "fields", "all", "global", "direct"}
+
+
+class FluxError(ValueError):
+    """Malformed topology definition."""
+
+
+def _import_class(path: str):
+    module, _, name = path.rpartition(".")
+    if not module:
+        raise FluxError(f"class {path!r} must be a dotted import path")
+    try:
+        return getattr(importlib.import_module(module), name)
+    except (ImportError, AttributeError) as e:
+        raise FluxError(f"cannot import {path!r}: {e}") from e
+
+
+def _build_value(value: Any, resources: Dict[str, Any]) -> Any:
+    if isinstance(value, str) and value.startswith("$"):
+        name = value[1:]
+        if name not in resources:
+            raise FluxError(f"unknown resource {value!r} "
+                            f"(have: {sorted(resources)})")
+        return resources[name]
+    if isinstance(value, dict) and "class" in value:
+        return _construct(value, resources)
+    if isinstance(value, dict):
+        return {k: _build_value(v, resources) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_build_value(v, resources) for v in value]
+    return value
+
+
+def _construct(spec: Dict[str, Any], resources: Dict[str, Any]) -> Any:
+    cls = _import_class(spec["class"])
+    args = [_build_value(v, resources) for v in spec.get("args_list", [])]
+    kwargs = {k: _build_value(v, resources)
+              for k, v in spec.get("args", {}).items()}
+    try:
+        return cls(*args, **kwargs)
+    except TypeError as e:
+        raise FluxError(f"constructing {spec['class']}: {e}") from e
+
+
+def _wire(declarer, groupings, component_id: str) -> None:
+    for g in groupings or []:
+        if "source" not in g:
+            raise FluxError(f"{component_id}: grouping needs a source")
+        gtype = g.get("type", "shuffle")
+        if gtype not in _GROUPINGS:
+            raise FluxError(
+                f"{component_id}: unknown grouping type {gtype!r} "
+                f"(one of {sorted(_GROUPINGS)})")
+        stream = g.get("stream", "default")
+        if gtype == "fields":
+            fields = g.get("fields")
+            if not fields:
+                raise FluxError(f"{component_id}: fields grouping needs "
+                                "a 'fields' list")
+            declarer.fields_grouping(g["source"], *fields, stream=stream)
+        elif gtype == "direct":
+            from storm_tpu.runtime import groupings as G
+
+            declarer.grouping(g["source"], G.DirectGrouping(), stream=stream)
+        else:
+            getattr(declarer, f"{gtype}_grouping")(g["source"], stream=stream)
+
+
+def load_topology(source, resources: Optional[Dict[str, Any]] = None) -> Topology:
+    """Build a Topology from a definition.
+
+    ``source`` is a dict, a path to a ``.toml``/``.json`` file, or a JSON
+    string. Caller-passed ``resources`` override same-named entries in the
+    definition's ``[resources]`` section."""
+    spec = _load_spec(source)
+    # Caller resources seed the table FIRST: definition resources may build
+    # on them ($broker from the CLI), and caller injection overrides
+    # same-named definition entries.
+    res: Dict[str, Any] = dict(resources or {})
+    for name, rspec in (spec.get("resources") or {}).items():
+        if name in res:
+            continue  # caller injection wins; skip constructing
+        if not isinstance(rspec, dict) or "class" not in rspec:
+            raise FluxError(f"resource {name!r} needs a 'class'")
+        res[name] = _construct(rspec, res)
+
+    tb = TopologyBuilder()
+    spouts = spec.get("spouts") or []
+    bolts = spec.get("bolts") or []
+    if not spouts:
+        raise FluxError("topology needs at least one spout")
+    for s in spouts:
+        _require(s, "spout")
+        tb.set_spout(s["id"], _construct(s, res),
+                     parallelism=int(s.get("parallelism", 1)))
+    for b in bolts:
+        _require(b, "bolt")
+        declarer = tb.set_bolt(b["id"], _construct(b, res),
+                               parallelism=int(b.get("parallelism", 1)))
+        _wire(declarer, b.get("groupings"), b["id"])
+    return tb.build()
+
+
+def topology_name(source) -> str:
+    return str(_load_spec(source).get("topology", {}).get("name", "flux-topology"))
+
+
+def _require(spec: Dict[str, Any], kind: str) -> None:
+    for key in ("id", "class"):
+        if key not in spec:
+            raise FluxError(f"every {kind} needs an {key!r}")
+
+
+def _load_spec(source) -> Dict[str, Any]:
+    if isinstance(source, dict):
+        return source
+    text = str(source)
+    if text.lstrip().startswith("{"):
+        return json.loads(text)
+    if text.endswith(".json"):
+        with open(text) as f:
+            return json.load(f)
+    if text.endswith(".toml"):
+        import tomllib
+
+        with open(text, "rb") as f:
+            return tomllib.load(f)
+    raise FluxError(f"can't load topology definition from {source!r} "
+                    "(dict, JSON string, .json or .toml path)")

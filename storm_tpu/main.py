@@ -126,11 +126,17 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
                       autoscale_target_ms: float = 0.0,
                       ui_port: int = -1,
                       metrics_file: str = "",
-                      metrics_interval_s: float = 10.0) -> None:
+                      metrics_interval_s: float = 10.0,
+                      topology_file: str = "") -> None:
     from storm_tpu.runtime.cluster import AsyncLocalCluster
 
     broker = _make_broker(cfg)
-    if cfg.pipelines:
+    if topology_file:
+        from storm_tpu.flux import load_topology
+
+        topo = load_topology(topology_file, resources={"broker": broker})
+        desc = f"flux:{topology_file}"
+    elif cfg.pipelines:
         topo = build_multi_model_topology(cfg, broker)
         desc = "+".join(p.model.name for p in cfg.pipelines)
     else:
@@ -224,6 +230,11 @@ def main(argv=None) -> int:
                       help="append a JSON-lines metrics snapshot to this "
                            "file every --metrics-interval seconds")
     runp.add_argument("--metrics-interval", type=float, default=10.0)
+    runp.add_argument("--topology-file", default="",
+                      help="declarative topology definition (TOML/JSON, the "
+                           "Storm Flux equivalent) instead of the standard "
+                           "spout->inference->sink shape; the configured "
+                           "broker is available as the $broker resource")
 
     distp = sub.add_parser(
         "dist-run",
@@ -271,7 +282,8 @@ def main(argv=None) -> int:
             )
         asyncio.run(_run_daemon(args.name, cfg, args.duration,
                                 args.autoscale_target_ms, args.ui_port,
-                                args.metrics_file, args.metrics_interval))
+                                args.metrics_file, args.metrics_interval,
+                                args.topology_file))
         return 0
 
     if args.cmd == "dist-run":

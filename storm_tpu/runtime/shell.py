@@ -85,6 +85,18 @@ class _ShellProtocol:
             raise RuntimeError(
                 f"shell component {self.command} failed the handshake: {hello}")
 
+    def _terminate(self) -> None:
+        """Kill + asynchronously reap (an unawaited child leaves the
+        transport open and a ResourceWarning)."""
+        if self._proc is not None and self._proc.returncode is None:
+            self._proc.kill()
+            try:
+                loop = asyncio.get_event_loop()
+                self._reaper = loop.create_task(self._proc.wait())
+            except RuntimeError:
+                pass  # no loop: interpreter shutdown
+        self._proc = None
+
 
 class ShellBolt(_ShellProtocol, Bolt):
     """Run a subprocess component over the multilang protocol.
@@ -136,9 +148,7 @@ class ShellBolt(_ShellProtocol, Bolt):
         for t in list(self._pending.values()):
             self.collector.fail(t)
         self._pending.clear()
-        if self._proc is not None and self._proc.returncode is None:
-            self._proc.kill()
-        self._proc = None
+        self._terminate()
 
     async def _reader(self) -> None:
         try:
@@ -228,15 +238,7 @@ class ShellBolt(_ShellProtocol, Bolt):
         for task in (self._reader_task, self._hb_task):
             if task is not None:
                 task.cancel()
-        if self._proc is not None and self._proc.returncode is None:
-            self._proc.kill()
-            # reap asynchronously so the transport closes cleanly (cleanup
-            # is sync; an unawaited child leaves a ResourceWarning)
-            try:
-                loop = asyncio.get_event_loop()
-                self._reaper = loop.create_task(self._proc.wait())
-            except RuntimeError:
-                pass  # no loop: interpreter shutdown
+        self._terminate()
 
 
 class ShellSpout(_ShellProtocol, Spout):
@@ -273,35 +275,38 @@ class ShellSpout(_ShellProtocol, Spout):
         # pipe; interleaving them would cross-read replies
         self._drive_lock = asyncio.Lock()
 
-    async def _drive(self, command: Dict[str, Any]) -> int:
+    async def _drive(self, command: Dict[str, Any], respawn: bool = True) -> int:
         """Send one control command; emit until the child syncs.
 
-        A wedged child (no sync within drive_timeout_s), a dead pipe, or
+        A wedged child (no reply within drive_timeout_s), a dead pipe, or
         framing corruption kills the child and resets for respawn on the
-        next drive — reported, never a silent desync."""
+        next drive — reported, never a silent desync. ``respawn=False``
+        (ack/fail) never starts a fresh child: a new process has no record
+        of the id being acked."""
         async with self._drive_lock:
             if self._closed:
                 return 0
             try:
-                return await asyncio.wait_for(
-                    self._drive_locked(command), self.drive_timeout_s)
+                return await self._drive_locked(command, respawn)
             except asyncio.CancelledError:
                 raise
             except Exception as e:
                 self.collector.report_error(e)
-                if self._proc is not None:
-                    if self._proc.returncode is None:
-                        self._proc.kill()
-                    self._proc = None
+                self._terminate()
                 return 0
 
-    async def _drive_locked(self, command: Dict[str, Any]) -> int:
+    async def _drive_locked(self, command: Dict[str, Any],
+                            respawn: bool) -> int:
         if self._proc is None or self._proc.returncode is not None:
+            if not respawn:
+                return 0
             await self._spawn({})
-        await self._send(command)
+        # Timeouts bound the CHILD's replies only; collector.emit may wait
+        # on downstream backpressure indefinitely, which is healthy.
+        await asyncio.wait_for(self._send(command), self.drive_timeout_s)
         emitted = 0
         while True:
-            msg = await self._read_msg()
+            msg = await asyncio.wait_for(self._read_msg(), self.drive_timeout_s)
             if msg is None:
                 self._proc = None  # child died; respawn on next drive
                 return emitted
@@ -328,10 +333,10 @@ class ShellSpout(_ShellProtocol, Spout):
         return await self._drive({"command": "next"}) > 0
 
     def ack(self, msg_id: Any) -> None:
-        self._bg(self._drive({"command": "ack", "id": msg_id}))
+        self._bg(self._drive({"command": "ack", "id": msg_id}, respawn=False))
 
     def fail(self, msg_id: Any) -> None:
-        self._bg(self._drive({"command": "fail", "id": msg_id}))
+        self._bg(self._drive({"command": "fail", "id": msg_id}, respawn=False))
 
     def _bg(self, coro) -> None:
         # ack/fail are sync spout callbacks; the protocol round trip runs
@@ -347,5 +352,4 @@ class ShellSpout(_ShellProtocol, Spout):
         if hasattr(self, "_bg_tasks"):
             for task in list(self._bg_tasks):
                 task.cancel()
-        if self._proc is not None and self._proc.returncode is None:
-            self._proc.kill()
+        self._terminate()

@@ -1,0 +1,162 @@
+"""Declarative topologies (storm_tpu/flux.py) — the Storm Flux equivalent:
+the reference's whole topology defined in TOML, built, and run e2e."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from storm_tpu.config import Config
+from storm_tpu.connectors.memory import MemoryBroker
+from storm_tpu.flux import FluxError, load_topology, topology_name
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+TOML = """
+[topology]
+name = "flux-demo"
+
+[[spouts]]
+id = "kafka-spout"
+class = "storm_tpu.connectors.spout.BrokerSpout"
+parallelism = 2
+args = { broker = "$broker", topic = "input" }
+
+[[bolts]]
+id = "inference-bolt"
+class = "storm_tpu.infer.operator.InferenceBolt"
+parallelism = 2
+groupings = [ { source = "kafka-spout", type = "shuffle" } ]
+
+[bolts.args]
+warmup = false
+model = { class = "storm_tpu.config.ModelConfig", args = { name = "lenet5", input_shape = [28, 28, 1] } }
+batch = { class = "storm_tpu.config.BatchConfig", args = { max_batch = 8, max_wait_ms = 20, buckets = [8] } }
+
+[[bolts]]
+id = "kafka-bolt"
+class = "storm_tpu.connectors.sink.BrokerSink"
+parallelism = 1
+args = { broker = "$broker", topic = "output" }
+groupings = [ { source = "inference-bolt", type = "shuffle" } ]
+
+[[bolts]]
+id = "dlq"
+class = "storm_tpu.connectors.sink.BrokerSink"
+args = { broker = "$broker", topic = "dead-letter" }
+groupings = [ { source = "inference-bolt", type = "shuffle", stream = "dead_letter" } ]
+"""
+
+
+def test_flux_builds_and_runs_reference_topology(run, tmp_path):
+    path = tmp_path / "topo.toml"
+    path.write_text(TOML)
+    broker = MemoryBroker()
+    topo = load_topology(str(path), resources={"broker": broker})
+    assert topology_name(str(path)) == "flux-demo"
+    assert topo.specs["kafka-spout"].parallelism == 2
+    assert topo.specs["inference-bolt"].parallelism == 2
+
+    async def go():
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("flux", Config(), topo)
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            broker.produce("input", json.dumps({"instances": rng.rand(1, 28, 28, 1).tolist()}))
+        broker.produce("input", '{"instances": [[1],[2,3]]}')
+        deadline = asyncio.get_event_loop().time() + 60
+        while asyncio.get_event_loop().time() < deadline:
+            if broker.topic_size("output") >= 5 and broker.topic_size("dead-letter") >= 1:
+                break
+            await asyncio.sleep(0.05)
+        await rt.drain(timeout_s=30)
+        outs = broker.drain_topic("output")
+        dlq = broker.drain_topic("dead-letter")
+        await cluster.shutdown()
+        assert len(outs) == 5 and len(dlq) == 1
+        for r in outs:
+            preds = json.loads(r.value)["predictions"]
+            assert len(preds[0]) == 10
+
+    run(go(), timeout=120)
+
+
+def test_flux_resources_and_nesting():
+    spec = {
+        "resources": {"broker": {"class": "storm_tpu.connectors.memory.MemoryBroker",
+                                 "args": {"default_partitions": 2}}},
+        "spouts": [{"id": "s", "class": "storm_tpu.connectors.spout.BrokerSpout",
+                    "args": {"broker": "$broker", "topic": "t"}}],
+        "bolts": [{"id": "b", "class": "storm_tpu.connectors.sink.BrokerSink",
+                   "args": {"broker": "$broker", "topic": "o"},
+                   "groupings": [{"source": "s", "type": "fields",
+                                  "fields": ["message"]}]}],
+    }
+    topo = load_topology(spec)
+    # both components share the ONE constructed broker resource
+    assert topo.specs["s"].obj.broker is topo.specs["b"].obj.broker
+    assert topo.specs["s"].obj.broker.partitions_for("t") == 2
+
+
+def test_flux_json_string():
+    spec = json.dumps({
+        "spouts": [{"id": "s", "class": "storm_tpu.connectors.spout.BrokerSpout",
+                    "args": {"broker": "$broker", "topic": "t"}}],
+        "bolts": [],
+    })
+    topo = load_topology(spec, resources={"broker": MemoryBroker()})
+    assert "s" in topo.specs
+
+
+def test_flux_errors():
+    base = {"spouts": [{"id": "s", "class": "storm_tpu.connectors.spout.BrokerSpout",
+                        "args": {"broker": "$broker", "topic": "t"}}]}
+    with pytest.raises(FluxError, match="at least one spout"):
+        load_topology({"spouts": []})
+    with pytest.raises(FluxError, match="unknown resource"):
+        load_topology(base)
+    with pytest.raises(FluxError, match="cannot import"):
+        load_topology({"spouts": [{"id": "s", "class": "no.such.Thing"}]})
+    with pytest.raises(FluxError, match="unknown grouping"):
+        load_topology({**base, "bolts": [
+            {"id": "b", "class": "storm_tpu.connectors.sink.BrokerSink",
+             "args": {"broker": "$broker", "topic": "o"},
+             "groupings": [{"source": "s", "type": "zigzag"}]}]},
+            resources={"broker": MemoryBroker()})
+    with pytest.raises(FluxError, match="needs an 'id'"):
+        load_topology({"spouts": [{"class": "storm_tpu.connectors.spout.BrokerSpout"}]})
+    with pytest.raises(FluxError, match="constructing"):
+        load_topology({"spouts": [{"id": "s",
+                                   "class": "storm_tpu.connectors.spout.BrokerSpout",
+                                   "args": {"bogus_kwarg": 1}}]})
+
+
+def test_flux_definition_resource_builds_on_caller_resource():
+    """A [resources] entry may reference caller-injected resources (the
+    CLI's $broker pattern)."""
+    broker = MemoryBroker()
+    spec = {
+        "resources": {"spout_proto": {
+            "class": "storm_tpu.connectors.spout.BrokerSpout",
+            "args": {"broker": "$broker", "topic": "t"}}},
+        "spouts": [{"id": "s", "class": "storm_tpu.connectors.spout.BrokerSpout",
+                    "args": {"broker": "$broker", "topic": "t"}}],
+        "bolts": [],
+    }
+    topo = load_topology(spec, resources={"broker": broker})
+    assert topo.specs["s"].obj.broker is broker
+
+
+def test_flux_direct_grouping_wires():
+    spec = {
+        "spouts": [{"id": "s", "class": "storm_tpu.connectors.spout.BrokerSpout",
+                    "args": {"broker": "$broker", "topic": "t"}}],
+        "bolts": [{"id": "b", "class": "storm_tpu.connectors.sink.BrokerSink",
+                   "args": {"broker": "$broker", "topic": "o"},
+                   "groupings": [{"source": "s", "type": "direct"}]}],
+    }
+    topo = load_topology(spec, resources={"broker": MemoryBroker()})
+    from storm_tpu.runtime.groupings import DirectGrouping
+
+    (sub,) = topo.specs["b"].inputs
+    assert isinstance(sub.grouping, DirectGrouping)
