@@ -1,0 +1,176 @@
+"""Event-time windows with watermarks (runtime/event_time.py): aligned
+buckets over the data's own clock, watermark-gated firing, late-tuple
+stream, sliding membership, per-tuple acking at last-window expiry."""
+
+import asyncio
+
+import pytest
+
+from storm_tpu.runtime.event_time import EventTimeWindowBolt
+from storm_tpu.runtime.tuples import Tuple as T, Values
+
+
+class _Coll:
+    def __init__(self):
+        self.acked, self.failed, self.emitted = [], [], []
+
+    def set_output_fields(self, f):
+        pass
+
+    def ack(self, t):
+        self.acked.append(t)
+
+    def fail(self, t):
+        self.failed.append(t)
+
+    def report_error(self, e):
+        self.errors = getattr(self, "errors", [])
+        self.errors.append(e)
+
+    async def emit(self, values, stream="default", **kw):
+        self.emitted.append((stream, list(values)))
+        return 1
+
+
+class Capture(EventTimeWindowBolt):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.windows = []
+
+    async def execute_window(self, tuples, start, end):
+        self.windows.append((start, end, [t.get("message") for t in tuples]))
+
+
+def _tup(msg, ts):
+    return T(values=[msg, ts], fields=("message", "ts"),
+             source_component="s", source_task=0)
+
+
+def _mk(**kw):
+    b = Capture(**kw)
+    b.collector = _Coll()
+    return b
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_tumbling_event_time_fires_on_watermark():
+    async def go():
+        b = _mk(window_s=10.0, lag_s=2.0)
+        for msg, ts in [("a", 1.0), ("b", 5.0), ("c", 9.0)]:
+            await b.execute(_tup(msg, ts))
+        assert b.windows == []  # watermark 7 < window end 10
+        await b.execute(_tup("d", 12.5))  # watermark 10.5 >= 10: fire
+        assert b.windows == [(0.0, 10.0, ["a", "b", "c"])]
+        assert len(b.collector.acked) == 3  # d still buffered
+        await b.flush()
+        assert b.windows[-1] == (10.0, 20.0, ["d"])
+        assert len(b.collector.acked) == 4
+
+    run(go())
+
+
+def test_out_of_order_within_lag_sorted_into_window():
+    async def go():
+        b = _mk(window_s=10.0, lag_s=5.0)
+        # watermark after ts=8 is 3 (lag 5); ts=4 is out of order but on time
+        for msg, ts in [("late-ish", 8.0), ("early", 4.0), ("x", 14.9)]:
+            await b.execute(_tup(msg, ts))
+        await b.execute(_tup("y", 15.1))  # watermark 10.1: first bucket fires
+        assert b.windows == [(0.0, 10.0, ["early", "late-ish"])]  # event order
+
+    run(go())
+
+
+def test_late_tuple_diverts_to_late_stream():
+    async def go():
+        b = _mk(window_s=10.0, lag_s=0.0)
+        await b.execute(_tup("a", 5.0))
+        await b.execute(_tup("b", 25.0))  # watermark 25: [0,10) fired
+        assert b.windows == [(0.0, 10.0, ["a"])]
+        await b.execute(_tup("straggler", 7.0))  # behind the watermark
+        late = [v for s, v in b.collector.emitted if s == "late"]
+        assert late == [[["straggler", 7.0], 7.0]]  # full values + event ts
+        # late tuple acked, never buffered
+        assert any(t.get("message") == "straggler" for t in b.collector.acked)
+
+    run(go())
+
+
+def test_sliding_membership_and_ack_at_last_window():
+    async def go():
+        b = _mk(window_s=10.0, slide_s=5.0, lag_s=0.0)
+        await b.execute(_tup("a", 7.0))  # belongs to [0,10) and [5,15)
+        await b.execute(_tup("z", 16.0))  # watermark 16: both fire
+        starts = [w[0] for w in b.windows]
+        assert starts == [0.0, 5.0]
+        assert all("a" in w[2] for w in b.windows)
+        # acked once, after its LAST window fired
+        assert [t.get("message") for t in b.collector.acked] == ["a"]
+
+    run(go())
+
+
+def test_window_failure_fails_its_tuples_only():
+    class Boom(Capture):
+        async def execute_window(self, tuples, start, end):
+            if start == 0.0:
+                raise RuntimeError("boom")
+            await super().execute_window(tuples, start, end)
+
+    async def go():
+        b = Boom(window_s=10.0, lag_s=0.0)
+        b.collector = _Coll()
+        await b.execute(_tup("a", 5.0))
+        await b.execute(_tup("b", 12.0))
+        await b.execute(_tup("z", 25.0))  # fires [0,10) (boom) and [10,20)
+        assert [t.get("message") for t in b.collector.failed] == ["a"]
+        assert [t.get("message") for t in b.collector.acked] == ["b"]
+        assert b.windows == [(10.0, 20.0, ["b"])]
+
+    run(go())
+
+
+def test_missing_timestamp_field_is_an_error():
+    async def go():
+        b = _mk(window_s=10.0)
+        bad = T(values=["x"], fields=("message",), source_component="s",
+                source_task=0)
+        with pytest.raises(ValueError, match="event-time field"):
+            await b.execute(bad)
+
+    run(go())
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        EventTimeWindowBolt(window_s=5.0, slide_s=6.0)
+    with pytest.raises(ValueError):
+        EventTimeWindowBolt(window_s=5.0, lag_s=-1.0)
+
+
+def test_float_windows_do_not_split_buckets():
+    async def go():
+        b = _mk(window_s=0.1, slide_s=0.1, lag_s=0.0)
+        await b.execute(_tup("a", 11.70))
+        await b.execute(_tup("b", 11.75))
+        await b.flush()
+        # ONE logical window [11.7, 11.8), not two float-drifted ones
+        assert len(b.windows) == 1
+        assert b.windows[0][2] == ["a", "b"]
+
+    run(go())
+
+
+def test_watermark_tie_is_not_late():
+    async def go():
+        b = _mk(window_s=10.0, lag_s=0.0)
+        await b.execute(_tup("a", 12.0))
+        await b.execute(_tup("b", 12.0))  # ties the watermark: NOT late
+        await b.flush()
+        assert b.windows[-1][2] == ["a", "b"]
+        assert not [v for s, v in b.collector.emitted if s == "late"]
+
+    run(go())
