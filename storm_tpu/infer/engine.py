@@ -27,6 +27,44 @@ from storm_tpu.parallel.mesh import make_mesh
 from storm_tpu.parallel.sharding import batch_sharding, replicated
 
 
+# ---- weight-only int8 quantization (w8a16 serving) ----------------------------
+
+
+def quantize_params(params, min_ndim: int = 2):
+    """f32/bf16 param pytree -> same tree with weight leaves replaced by
+    ``{"__q": int8, "__s": f32 per-output-channel scales}``.
+
+    Symmetric per-output-channel (last axis) quantization; leaves below
+    ``min_ndim`` (biases, norm scales) stay full precision — they are tiny
+    and precision-critical."""
+    def quant(leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim < min_ndim or \
+                leaf.dtype.kind not in "fV":  # V: bfloat16 shows as void-kind
+            return leaf
+        w = np.asarray(leaf, np.float32)
+        axes = tuple(range(w.ndim - 1))
+        scale = np.max(np.abs(w), axis=axes) / 127.0
+        scale = np.maximum(scale, 1e-12).astype(np.float32)
+        q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+        return {"__q": q, "__s": scale}
+
+    return jax.tree.map(quant, params)
+
+
+def _is_qleaf(x) -> bool:
+    return isinstance(x, dict) and "__q" in x
+
+
+def dequantize_params(qparams, dtype):
+    """Inverse of :func:`quantize_params`; runs INSIDE jit so XLA fuses the
+    int8->dtype multiply into each weight's first use."""
+    return jax.tree.map(
+        lambda l: (l["__q"].astype(dtype) * l["__s"].astype(dtype)
+                   if _is_qleaf(l) else l),
+        qparams, is_leaf=lambda l: _is_qleaf(l),
+    )
+
+
 class InferenceEngine:
     def __init__(
         self,
@@ -60,14 +98,31 @@ class InferenceEngine:
         )
         # BN statistics stay f32 (cast only f32 leaves to compute dtype would
         # nuke them too) — so cast params only; state is small and stays f32.
-        self.params = jax.device_put(cast(params), replicated(self.mesh))
+        self._w8 = getattr(model_cfg, "weights", "float") == "int8"
+        if self._w8:
+            # int8 weights + scales live in HBM; dequant happens inside the
+            # jit program (fused), so the stored footprint is ~1/2 of bf16.
+            # Non-quantized leaves (biases, norm params) still get the
+            # compute-dtype cast — an f32 bias-add would promote every
+            # downstream activation to f32 and defeat w8a16.
+            qtree = jax.tree.map(
+                lambda l: l if _is_qleaf(l) else (
+                    l.astype(self.dtype) if l.dtype == jnp.float32 else l),
+                quantize_params(params), is_leaf=_is_qleaf,
+            )
+            self.params = jax.device_put(qtree, replicated(self.mesh))
+        else:
+            self.params = jax.device_put(cast(params), replicated(self.mesh))
         self.state = jax.device_put(state, replicated(self.mesh))
 
         apply = self.model.apply
         x_shard = batch_sharding(self.mesh, self.data_axis)
         dtype = self.dtype
+        w8 = self._w8
 
         def fwd(params, state, x):
+            if w8:
+                params = dequantize_params(params, dtype)
             logits, _ = apply(params, state, x, train=False)
             logits = logits.astype(jnp.float32)
             return jax.nn.softmax(logits, axis=-1) if softmax else logits
@@ -198,6 +253,7 @@ def shared_engine(
         model_cfg.num_classes,
         model_cfg.checkpoint,
         model_cfg.seed,
+        getattr(model_cfg, "weights", "float"),
         # builder kwargs are part of the model identity (width=0.5 vs 1.0
         # must not share one cached engine); deep-freeze so TOML-sourced
         # list values stay hashable

@@ -77,3 +77,11 @@ def test_broker_config_validates_message_format():
         BrokerConfig(message_format="V2")
     with pytest.raises(ValueError, match="kind"):
         BrokerConfig(kind="rabbitmq")
+
+
+def test_model_config_validates_weights():
+    from storm_tpu.config import ModelConfig
+
+    assert ModelConfig(weights="int8").weights == "int8"
+    with pytest.raises(ValueError, match="weights"):
+        ModelConfig(weights="int4")

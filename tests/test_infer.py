@@ -174,3 +174,86 @@ def test_engine_uint8_constant_input_no_nan():
 def test_model_config_rejects_bad_transfer_dtype():
     with pytest.raises(ValueError):
         ModelConfig(transfer_dtype="int4")
+
+
+# ---- weight-only int8 quantization (w8a16) -----------------------------------
+
+
+def test_int8_weights_predictions_close_to_float():
+    import numpy as np
+
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.infer.engine import InferenceEngine
+
+    x = np.random.RandomState(0).rand(4, 28, 28, 1).astype(np.float32)
+    outs = {}
+    for weights in ("float", "int8"):
+        eng = InferenceEngine(
+            ModelConfig(name="lenet5", input_shape=(28, 28, 1), dtype="float32",
+                        weights=weights),
+            ShardingConfig(data_parallel=0),
+            BatchConfig(max_batch=4, buckets=(4,)),
+        )
+        outs[weights] = eng.predict(x)
+    np.testing.assert_allclose(outs["float"].sum(axis=1), 1.0, atol=1e-4)
+    # per-channel symmetric int8 stays close on softmax outputs
+    assert np.max(np.abs(outs["float"] - outs["int8"])) < 0.05
+    # argmax must agree wherever the float decision is decisive (random-init
+    # outputs are near-uniform; quantization may flip exact ties)
+    top2 = np.sort(outs["float"], axis=1)[:, -2:]
+    decisive = (top2[:, 1] - top2[:, 0]) > 0.05
+    assert np.all(
+        np.argmax(outs["float"], 1)[decisive]
+        == np.argmax(outs["int8"], 1)[decisive]
+    )
+
+
+def test_int8_weights_shrink_param_bytes():
+    import jax
+    import numpy as np
+
+    from storm_tpu.infer.engine import dequantize_params, quantize_params
+    from storm_tpu.models import build_model
+    from storm_tpu.models.registry import init_params
+
+    model = build_model("lenet5")
+    params, _ = init_params(model, seed=0)
+
+    def nbytes(tree):
+        return sum(np.asarray(l).nbytes
+                   for l in jax.tree.leaves(tree))
+
+    q = quantize_params(params)
+    assert nbytes(q) < 0.4 * nbytes(params)  # f32 -> int8 + small scales
+    # dequant round trip stays within one quantization step per channel
+    deq = dequantize_params(q, np.float32)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(deq)):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        if a.ndim >= 2:
+            step = np.max(np.abs(a)) / 127.0
+            assert np.max(np.abs(a - b)) <= step + 1e-6
+        else:
+            np.testing.assert_array_equal(a, b)  # biases untouched
+
+
+def test_int8_weights_bf16_keeps_compute_dtype():
+    """Non-quantized leaves are cast to the compute dtype: an f32 bias
+    would promote every activation back to f32."""
+    import jax
+    import jax.numpy as jnp
+
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.infer.engine import InferenceEngine, _is_qleaf
+
+    eng = InferenceEngine(
+        ModelConfig(name="lenet5", input_shape=(28, 28, 1), dtype="bfloat16",
+                    weights="int8"),
+        ShardingConfig(data_parallel=0),
+        BatchConfig(max_batch=4, buckets=(4,)),
+    )
+    for leaf in jax.tree.leaves(
+            eng.params, is_leaf=lambda l: _is_qleaf(l)):
+        if _is_qleaf(leaf):
+            assert leaf["__q"].dtype == jnp.int8
+        else:
+            assert leaf.dtype != jnp.float32, "f32 leaf would promote activations"
