@@ -203,6 +203,62 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
     print(json.dumps(snap, default=str), file=sys.stderr)
 
 
+def _ctl(args) -> int:
+    """Drive a running daemon's UI HTTP API from the command line."""
+    import urllib.error
+    import urllib.request
+
+    base = args.url.rstrip("/")
+
+    def call(method, path, body=None):
+        req = urllib.request.Request(
+            base + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return 0, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                return 1, json.loads(raw)
+            except ValueError:
+                # not our daemon (proxy error page etc.): show what came back
+                return 1, {"error": f"HTTP {e.code} from {base}",
+                           "body": raw[:500].decode("utf-8", "replace")}
+        except urllib.error.URLError as e:
+            print(f"cannot reach {base}: {e}", file=sys.stderr)
+            raise SystemExit(2)
+
+    cmd = args.ctl_cmd
+    if cmd == "list":
+        rc, out = call("GET", "/api/v1/topology/summary")
+    elif cmd == "status":
+        rc, out = call("GET", f"/api/v1/topology/{args.topology}")
+    elif cmd in ("metrics", "graph", "errors"):
+        rc, out = call("GET", f"/api/v1/topology/{args.topology}/{cmd}")
+    elif cmd in ("activate", "deactivate"):
+        rc, out = call("POST", f"/api/v1/topology/{args.topology}/{cmd}")
+    elif cmd == "drain":
+        rc, out = call("POST", f"/api/v1/topology/{args.topology}/deactivate")
+    elif cmd == "kill":
+        rc, out = call("POST", f"/api/v1/topology/{args.topology}/kill",
+                       {"wait_secs": args.wait_secs})
+    elif cmd == "rebalance":
+        rc, out = call("POST", f"/api/v1/topology/{args.topology}/rebalance",
+                       {"component": args.component,
+                        "parallelism": args.parallelism})
+    elif cmd == "logs":
+        rc, out = call(
+            "GET",
+            f"/api/v1/topology/{args.topology}/logs"
+            f"?worker={args.worker}&bytes={args.bytes}")
+        if rc == 0:
+            print(out.get("log", ""))
+            return 0
+    print(json.dumps(out, indent=2, default=str))
+    return rc
+
+
 def main(argv=None) -> int:
     setup_logging()
     ap = argparse.ArgumentParser(prog="storm_tpu")
@@ -267,6 +323,31 @@ def main(argv=None) -> int:
 
     sub.add_parser("info", help="print devices and registered models")
 
+    ctlp = sub.add_parser(
+        "ctl", help="control a running daemon over its UI HTTP API "
+                    "(the storm kill/activate/deactivate/rebalance CLI)")
+    ctlp.add_argument("--url", default="http://127.0.0.1:8080",
+                      help="base URL of the daemon's --ui-port server")
+    ctlsub = ctlp.add_subparsers(dest="ctl_cmd", required=True)
+    for cmd in ("list", "status", "metrics", "graph", "errors"):
+        c = ctlsub.add_parser(cmd)
+        if cmd != "list":
+            c.add_argument("topology")
+    for cmd in ("activate", "deactivate", "drain"):
+        c = ctlsub.add_parser(cmd)
+        c.add_argument("topology")
+    c = ctlsub.add_parser("kill")
+    c.add_argument("topology")
+    c.add_argument("--wait-secs", type=float, default=0.0)
+    c = ctlsub.add_parser("rebalance")
+    c.add_argument("topology")
+    c.add_argument("component")
+    c.add_argument("parallelism", type=int)
+    c = ctlsub.add_parser("logs")
+    c.add_argument("topology")
+    c.add_argument("--worker", type=int, default=0)
+    c.add_argument("--bytes", type=int, default=16384)
+
     args = ap.parse_args(argv)
 
     if args.cmd == "run":
@@ -285,6 +366,9 @@ def main(argv=None) -> int:
                                 args.metrics_file, args.metrics_interval,
                                 args.topology_file))
         return 0
+
+    if args.cmd == "ctl":
+        return _ctl(args)
 
     if args.cmd == "dist-run":
         cfg = _load_config(args)

@@ -30,6 +30,7 @@ Subclasses implement ``execute_window(tuples, start, end)``.
 from __future__ import annotations
 
 import math
+import time
 from typing import Dict, List, Optional, Tuple as Tup
 
 from storm_tpu.runtime.base import Bolt
@@ -43,6 +44,7 @@ class EventTimeWindowBolt(Bolt):
         slide_s: Optional[float] = None,
         timestamp_field: str = "ts",
         lag_s: float = 1.0,
+        idle_advance_s: float = 0.0,
     ) -> None:
         self.window_s = float(window_s)
         self.slide_s = float(slide_s or window_s)
@@ -52,6 +54,17 @@ class EventTimeWindowBolt(Bolt):
             raise ValueError("lag_s must be >= 0")
         self.timestamp_field = timestamp_field
         self.lag_s = float(lag_s)
+        # idle_advance_s > 0: if no tuple arrives for this much PROCESSING
+        # time, collapse the lag — the watermark jumps to max event time and
+        # pending windows fire (an idle stream must not strand its tail
+        # until drain). Needs topology.tick_interval_s > 0 to get ticks.
+        self.idle_advance_s = float(idle_advance_s)
+        if self.idle_advance_s > 0:
+            # self-provision ticks (the executor honors this attribute, the
+            # same mechanism processing-time windows use) — the knob must
+            # work without separately setting topology.tick_interval_s
+            self.tick_interval_s = self.idle_advance_s / 2
+        self._last_arrival = None
         #: bucket INDEX k -> [(tuple, event_ts)] where the window is
         #: [k*slide_s, k*slide_s + window_s). Integer keys: float bucket
         #: starts computed by repeated addition drift (0.1 + 0.1 + ...),
@@ -102,6 +115,10 @@ class EventTimeWindowBolt(Bolt):
                 f"tuple from {t.source_component} lacks event-time field "
                 f"{self.timestamp_field!r}")
         ts = float(ts)
+        # ANY arrival counts as stream activity — a steady stream of
+        # stragglers must not be mistaken for idleness (collapsing the lag
+        # would misdivert on-time tuples to the late stream).
+        self._last_arrival = time.monotonic()
         if ts < self._watermark:  # strict: a tie's window has NOT fired yet
             # Late: its windows already fired. Divert, never silently drop.
             await self.collector.emit(
@@ -154,6 +171,17 @@ class EventTimeWindowBolt(Bolt):
                     self.collector.ack(t)
         self._min_end = (min(self._bucket_end(k) for k in self._buckets)
                          if self._buckets else math.inf)
+
+    async def tick(self) -> None:
+        """Idle advance: with no arrivals for idle_advance_s, fire every
+        window up to the max event time seen (lag collapsed)."""
+        if self.idle_advance_s <= 0 or self._last_arrival is None:
+            return
+        if time.monotonic() - self._last_arrival < self.idle_advance_s:
+            return
+        if self._max_event > self._watermark:
+            self._watermark = self._max_event
+            await self._fire_ready()
 
     async def flush(self) -> None:
         """Graceful drain: fire every remaining bucket (watermark ignored —

@@ -1,0 +1,75 @@
+"""The ctl CLI (storm kill/activate/rebalance command-line equivalent):
+main.py's ctl subcommand driving a live UI server over HTTP."""
+
+import asyncio
+import io
+import json
+from contextlib import redirect_stdout
+
+from storm_tpu.config import Config
+from storm_tpu.main import main as cli_main
+from storm_tpu.runtime.cluster import AsyncLocalCluster
+from storm_tpu.runtime.ui import UIServer
+from tests.test_ui import EchoBolt, TrickleSpout
+
+
+def _ctl(url, *argv):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(["ctl", "--url", url, *argv])
+    return rc, buf.getvalue()
+
+
+def test_ctl_commands_against_live_daemon(run):
+    async def go():
+        from storm_tpu.runtime import TopologyBuilder
+
+        tb = TopologyBuilder()
+        tb.set_spout("spout", TrickleSpout(), parallelism=1)
+        tb.set_bolt("echo", EchoBolt(), parallelism=2).shuffle_grouping("spout")
+        cluster = AsyncLocalCluster()
+        await cluster.submit("demo", Config(), tb.build())
+        ui = await UIServer(cluster, port=0).start()
+        url = f"http://127.0.0.1:{ui.port}"
+        loop = asyncio.get_running_loop()
+        try:
+            rc, out = await loop.run_in_executor(None, _ctl, url, "list")
+            assert rc == 0 and json.loads(out)["topologies"][0]["name"] == "demo"
+
+            rc, out = await loop.run_in_executor(
+                None, _ctl, url, "status", "demo")
+            assert rc == 0 and json.loads(out)["status"] == "ACTIVE"
+
+            rc, out = await loop.run_in_executor(
+                None, _ctl, url, "rebalance", "demo", "echo", "3")
+            assert rc == 0
+            assert len(cluster.runtime("demo").bolt_execs["echo"]) == 3
+
+            rc, out = await loop.run_in_executor(
+                None, _ctl, url, "deactivate", "demo")
+            assert rc == 0 and json.loads(out)["status"] == "INACTIVE"
+            rc, out = await loop.run_in_executor(
+                None, _ctl, url, "activate", "demo")
+            assert rc == 0
+
+            rc, out = await loop.run_in_executor(
+                None, _ctl, url, "graph", "demo")
+            assert rc == 0 and "edges" in json.loads(out)
+
+            rc, out = await loop.run_in_executor(
+                None, _ctl, url, "status", "nope")
+            assert rc == 1  # HTTP error surfaces as nonzero exit
+
+            rc, out = await loop.run_in_executor(
+                None, _ctl, url, "kill", "demo")
+            assert rc == 0
+            for _ in range(100):
+                if "demo" not in cluster.runtimes:
+                    break
+                await asyncio.sleep(0.05)
+            assert "demo" not in cluster.runtimes
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=120)

@@ -174,3 +174,45 @@ def test_watermark_tie_is_not_late():
         assert not [v for s, v in b.collector.emitted if s == "late"]
 
     run(go())
+
+
+def test_idle_advance_fires_stranded_windows():
+    async def go():
+        b = _mk(window_s=10.0, lag_s=100.0, idle_advance_s=0.05)
+        await b.execute(_tup("a", 5.0))
+        await b.execute(_tup("b", 12.0))
+        assert b.windows == []  # lag 100 would strand these for ages
+        await b.tick()  # not idle yet
+        assert b.windows == []
+        await asyncio.sleep(0.08)
+        await b.tick()  # idle: watermark jumps to max event (12.0)
+        assert b.windows == [(0.0, 10.0, ["a"])]  # [10,20) holds b (end 20 > 12)
+        # a late tuple after the collapsed watermark diverts
+        await b.execute(_tup("straggler", 3.0))
+        assert any(s == "late" for s, _ in b.collector.emitted)
+
+    run(go())
+
+
+def test_idle_advance_self_provisions_ticks():
+    b = EventTimeWindowBolt(window_s=10.0, idle_advance_s=4.0)
+    # the executor reads this attribute to drive tick(); without it the
+    # knob would silently never fire
+    assert b.tick_interval_s == 2.0
+    assert not hasattr(EventTimeWindowBolt(window_s=10.0), "tick_interval_s")
+
+
+def test_straggler_stream_is_not_idle():
+    async def go():
+        b = _mk(window_s=10.0, lag_s=0.0, idle_advance_s=10.0)
+        await b.execute(_tup("a", 5.0))
+        await b.execute(_tup("b", 25.0))  # watermark 25
+        b._max_event = 100.0  # pretend a much newer event was seen
+        # stragglers keep arriving: activity, even though they're late
+        await b.execute(_tup("s1", 1.0))
+        assert b._last_arrival is not None
+        import time as _t
+
+        assert _t.monotonic() - b._last_arrival < 1.0
+
+    run(go())
