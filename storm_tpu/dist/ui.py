@@ -144,6 +144,9 @@ class DistRuntimeView:
     async def deactivate(self) -> None:
         await asyncio.to_thread(self._dist.deactivate)
 
+    async def drain(self, timeout_s: float = 30.0) -> bool:
+        return await asyncio.to_thread(self._dist.drain, timeout_s)
+
     async def rebalance(self, component: str, parallelism: int) -> None:
         await asyncio.to_thread(self._dist.rebalance, component, parallelism)
 

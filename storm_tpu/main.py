@@ -206,9 +206,11 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
 def _ctl(args) -> int:
     """Drive a running daemon's UI HTTP API from the command line."""
     import urllib.error
+    import urllib.parse
     import urllib.request
 
     base = args.url.rstrip("/")
+    topo = urllib.parse.quote(getattr(args, "topology", ""), safe="")
 
     def call(method, path, body=None):
         req = urllib.request.Request(
@@ -233,24 +235,25 @@ def _ctl(args) -> int:
     if cmd == "list":
         rc, out = call("GET", "/api/v1/topology/summary")
     elif cmd == "status":
-        rc, out = call("GET", f"/api/v1/topology/{args.topology}")
+        rc, out = call("GET", f"/api/v1/topology/{topo}")
     elif cmd in ("metrics", "graph", "errors"):
-        rc, out = call("GET", f"/api/v1/topology/{args.topology}/{cmd}")
+        rc, out = call("GET", f"/api/v1/topology/{topo}/{cmd}")
     elif cmd in ("activate", "deactivate"):
-        rc, out = call("POST", f"/api/v1/topology/{args.topology}/{cmd}")
+        rc, out = call("POST", f"/api/v1/topology/{topo}/{cmd}")
     elif cmd == "drain":
-        rc, out = call("POST", f"/api/v1/topology/{args.topology}/deactivate")
+        rc, out = call("POST", f"/api/v1/topology/{topo}/drain",
+                       {"timeout_s": 30.0})
     elif cmd == "kill":
-        rc, out = call("POST", f"/api/v1/topology/{args.topology}/kill",
+        rc, out = call("POST", f"/api/v1/topology/{topo}/kill",
                        {"wait_secs": args.wait_secs})
     elif cmd == "rebalance":
-        rc, out = call("POST", f"/api/v1/topology/{args.topology}/rebalance",
+        rc, out = call("POST", f"/api/v1/topology/{topo}/rebalance",
                        {"component": args.component,
                         "parallelism": args.parallelism})
     elif cmd == "logs":
         rc, out = call(
             "GET",
-            f"/api/v1/topology/{args.topology}/logs"
+            f"/api/v1/topology/{topo}/logs"
             f"?worker={args.worker}&bytes={args.bytes}")
         if rc == 0:
             print(out.get("log", ""))

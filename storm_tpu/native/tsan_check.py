@@ -1,0 +1,58 @@
+"""ThreadSanitizer check for the native layer (SURVEY.md §5.2).
+
+Build the instrumented library and hammer every exported hot path from 8
+threads under TSan:
+
+    make -C storm_tpu/native tsan-check
+
+Any data race prints a ``WARNING: ThreadSanitizer`` report; a clean run
+ends with TSAN-HAMMER-OK. (libtsan must be LD_PRELOADed because the .so
+is dlopened — the Makefile target handles that.)
+"""
+
+import ctypes, threading
+import pathlib
+
+_here = pathlib.Path(__file__).resolve().parent
+lib = ctypes.CDLL(str(_here / "libstormtpu_tsan.so"))
+lib.stpu_parse_instances.restype = ctypes.c_void_p
+lib.stpu_parse_instances.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                     ctypes.POINTER(ctypes.c_int64),
+                                     ctypes.POINTER(ctypes.c_int32),
+                                     ctypes.POINTER(ctypes.c_char_p)]
+lib.stpu_free.restype = None
+lib.stpu_free.argtypes = [ctypes.c_void_p]
+lib.stpu_crc32c.restype = ctypes.c_uint32
+lib.stpu_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+lib.stpu_tensor_encode.restype = ctypes.c_void_p
+lib.stpu_tensor_encode.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_size_t)]
+payload = ('{"instances": [' + ",".join(
+    "[" + ",".join("[[0.5,0.25,0.125]]" for _ in range(4)) + "]" for _ in range(8)
+) + ']}').encode()
+data = bytes(range(256)) * 64
+
+def worker(n):
+    import array
+    shape = (ctypes.c_int64 * 8)()
+    rank = ctypes.c_int32(0)
+    err = ctypes.c_char_p(None)
+    buf = array.array("f", [0.5] * 96)
+    eshape = (ctypes.c_int64 * 8)(8, 4, 3, 0, 0, 0, 0, 0)
+    elen = ctypes.c_size_t(0)
+    addr, _ = buf.buffer_info()
+    for _ in range(n):
+        p = lib.stpu_parse_instances(payload, len(payload), shape,
+                                     ctypes.byref(rank), ctypes.byref(err))
+        assert p
+        lib.stpu_free(p)
+        lib.stpu_crc32c(data, len(data), 0)
+        q = lib.stpu_tensor_encode(addr, 0, 3, eshape, ctypes.byref(elen))
+        assert q
+        lib.stpu_free(q)
+
+threads = [threading.Thread(target=worker, args=(300,)) for _ in range(8)]
+for t in threads: t.start()
+for t in threads: t.join()
+print("TSAN-HAMMER-OK: 8 threads x 300 iterations (parse+crc32c+arrow-encode)")

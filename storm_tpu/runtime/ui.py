@@ -22,6 +22,7 @@ Read routes
 Admin routes (POST, like Storm UI's topology actions)
     POST /api/v1/topology/{name}/activate
     POST /api/v1/topology/{name}/deactivate
+    POST /api/v1/topology/{name}/drain        deactivate + wait in-flight
     POST /api/v1/topology/{name}/rebalance    body {"component":, "parallelism":}
     POST /api/v1/topology/{name}/kill         body {"wait_secs": 0} (optional)
 
@@ -342,6 +343,14 @@ class UIServer:
         if action == "deactivate":
             await rt.deactivate()
             return 200, {"status": "INACTIVE"}
+        if action == "drain":
+            try:
+                timeout_s = float(args.get("timeout_s", 30.0))
+            except (TypeError, ValueError):
+                return 400, {"error": "timeout_s must be a number"}
+            await rt.deactivate()
+            ok = await rt.drain(timeout_s=timeout_s)
+            return 200, {"status": "INACTIVE", "drained": bool(ok)}
         if action == "rebalance":
             component = args.get("component")
             try:

@@ -73,3 +73,32 @@ def test_ctl_commands_against_live_daemon(run):
             await cluster.shutdown()
 
     run(go(), timeout=120)
+
+
+def test_ctl_drain_waits_for_inflight(run):
+    """ctl drain hits the real drain route: deactivate + in-flight wait,
+    not a bare deactivate."""
+
+    async def go():
+        from storm_tpu.runtime import TopologyBuilder
+
+        tb = TopologyBuilder()
+        tb.set_spout("spout", TrickleSpout(), parallelism=1)
+        tb.set_bolt("echo", EchoBolt(), parallelism=1).shuffle_grouping("spout")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("d", Config(), tb.build())
+        ui = await UIServer(cluster, port=0).start()
+        url = f"http://127.0.0.1:{ui.port}"
+        loop = asyncio.get_running_loop()
+        try:
+            await asyncio.sleep(0.2)
+            rc, out = await loop.run_in_executor(None, _ctl, url, "drain", "d")
+            assert rc == 0
+            body = json.loads(out)
+            assert body["status"] == "INACTIVE" and body["drained"] is True
+            assert rt.ledger.inflight == 0
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
