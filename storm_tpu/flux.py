@@ -119,13 +119,38 @@ def _wire(declarer, groupings, component_id: str) -> None:
             getattr(declarer, f"{gtype}_grouping")(g["source"], stream=stream)
 
 
-def load_topology(source, resources: Optional[Dict[str, Any]] = None) -> Topology:
+def validate_class_paths(spec: Dict[str, Any],
+                         prefixes: "tuple[str, ...]") -> None:
+    """Reject any ``class`` path outside the allowed module prefixes —
+    required before constructing definitions from UNTRUSTED input (the
+    remote-submit route): a dotted path is arbitrary code execution."""
+    def walk(node):
+        if isinstance(node, dict):
+            cls = node.get("class")
+            if isinstance(cls, str) and not cls.startswith(prefixes):
+                raise FluxError(
+                    f"class {cls!r} outside the allowed prefixes {prefixes}")
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+
+    walk(spec)
+
+
+def load_topology(source, resources: Optional[Dict[str, Any]] = None,
+                  class_prefixes: "Optional[tuple[str, ...]]" = None) -> Topology:
     """Build a Topology from a definition.
 
     ``source`` is a dict, a path to a ``.toml``/``.json`` file, or a JSON
     string. Caller-passed ``resources`` override same-named entries in the
-    definition's ``[resources]`` section."""
+    definition's ``[resources]`` section. ``class_prefixes`` restricts
+    every ``class`` path to the given module prefixes (pass it whenever the
+    definition comes from an untrusted channel)."""
     spec = _load_spec(source)
+    if class_prefixes is not None:
+        validate_class_paths(spec, tuple(class_prefixes))
     # Caller resources seed the table FIRST: definition resources may build
     # on them ($broker from the CLI), and caller injection overrides
     # same-named definition entries.

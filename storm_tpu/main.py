@@ -176,7 +176,9 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
     if ui_port >= 0:
         from storm_tpu.runtime.ui import UIServer
 
-        ui = await UIServer(cluster, port=ui_port).start()
+        # remote submission gets the daemon's broker as $broker
+        ui = await UIServer(cluster, port=ui_port,
+                            resources={"broker": broker}).start()
     print(f"topology {name!r} running "
           f"(model={desc}, broker={cfg.broker.kind}"
           f"{', autoscaling' if scalers else ''}"
@@ -212,12 +214,14 @@ def _ctl(args) -> int:
     base = args.url.rstrip("/")
     topo = urllib.parse.quote(getattr(args, "topology", ""), safe="")
 
-    def call(method, path, body=None):
+    def call(method, path, body=None, timeout=30, headers=None):
         req = urllib.request.Request(
             base + path, method=method,
             data=json.dumps(body).encode() if body is not None else None)
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
         try:
-            with urllib.request.urlopen(req, timeout=30) as r:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
                 return 0, json.loads(r.read())
         except urllib.error.HTTPError as e:
             raw = e.read()
@@ -241,8 +245,10 @@ def _ctl(args) -> int:
     elif cmd in ("activate", "deactivate"):
         rc, out = call("POST", f"/api/v1/topology/{topo}/{cmd}")
     elif cmd == "drain":
+        # client timeout comfortably beyond the server's drain wait, or a
+        # slow drain would look like a connectivity failure
         rc, out = call("POST", f"/api/v1/topology/{topo}/drain",
-                       {"timeout_s": 30.0})
+                       {"timeout_s": 30.0}, timeout=60)
     elif cmd == "kill":
         rc, out = call("POST", f"/api/v1/topology/{topo}/kill",
                        {"wait_secs": args.wait_secs})
@@ -258,6 +264,13 @@ def _ctl(args) -> int:
         if rc == 0:
             print(out.get("log", ""))
             return 0
+    elif cmd == "submit":
+        from storm_tpu.flux import _load_spec
+
+        rc, out = call("POST", "/api/v1/topology/submit",
+                       {"name": args.topology,
+                        "definition": _load_spec(args.definition)},
+                       headers={"X-Storm-Tpu-Submit": "1"})
     print(json.dumps(out, indent=2, default=str))
     return rc
 
@@ -350,6 +363,10 @@ def main(argv=None) -> int:
     c.add_argument("topology")
     c.add_argument("--worker", type=int, default=0)
     c.add_argument("--bytes", type=int, default=16384)
+    c = ctlsub.add_parser(
+        "submit", help="submit a Flux topology definition to the daemon")
+    c.add_argument("topology")
+    c.add_argument("definition", help="TOML/JSON topology file")
 
     args = ap.parse_args(argv)
 

@@ -54,11 +54,16 @@ class UIServer:
     """Serve status/admin HTTP for the topologies in an AsyncLocalCluster."""
 
     def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0,
-                 drpc=None) -> None:
+                 drpc=None, resources=None) -> None:
         self.cluster = cluster
         self.host = host
         self.port = port  # replaced by the bound port after start()
         self.drpc = drpc  # optional DRPCServer: enables /api/v1/drpc/{fn}
+        # shared objects exposed to submitted Flux definitions ($broker...);
+        # None disables remote submission entirely
+        self.resources = resources
+        #: module prefixes a submitted definition's class paths may use
+        self.submit_class_prefixes: tuple = ("storm_tpu.",)
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = time.monotonic()
         self._kill_tasks: set = set()
@@ -100,7 +105,8 @@ class UIServer:
         else:
             body = json.dumps(payload, default=str).encode()
             ctype = "application/json"
-        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+        reason = {200: "OK", 400: "Bad Request", 403: "Forbidden",
+                  404: "Not Found",
                   405: "Method Not Allowed", 413: "Payload Too Large",
                   500: "Internal Server Error", 502: "Bad Gateway",
                   504: "Gateway Timeout"}
@@ -125,11 +131,13 @@ class UIServer:
             return 400, {"error": "malformed request line"}
         method, target, _version = parts
         content_length = 0
+        headers: Dict[str, str] = {}
         while True:
             line = (await reader.readline()).decode("latin-1").strip()
             if not line:
                 break
             k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
             if k.strip().lower() == "content-length":
                 try:
                     content_length = int(v)
@@ -152,12 +160,15 @@ class UIServer:
                     return 400, {"error": "body must be a JSON object"}
         url = urlsplit(target)
         query = {k: v[-1] for k, v in parse_qs(url.query).items()}
-        return await self._route(method, url.path.rstrip("/"), query, body)
+        return await self._route(method, url.path.rstrip("/"), query, body,
+                                 headers)
 
     # ---- routing -------------------------------------------------------------
 
     async def _route(self, method: str, path: str, query: Dict[str, str],
-                     body: Dict[str, Any]) -> Tuple[int, Any]:
+                     body: Dict[str, Any],
+                     headers: Dict[str, str] = None) -> Tuple[int, Any]:
+        headers = headers or {}
         if path == "/healthz":
             return 200, {"status": "ok", "uptime_s": round(time.monotonic() - self._started, 3)}
         if path == "/metrics":
@@ -175,6 +186,40 @@ class UIServer:
             rts = list(self._runtimes().values())
             return 200, {"topologies": await asyncio.to_thread(
                 lambda: [self._topo_summary(rt) for rt in rts])}
+        if path == "/api/v1/topology/submit":
+            # StormSubmitter over the wire: a Flux definition becomes a
+            # running topology on this daemon's cluster.
+            if method != "POST":
+                return 405, {"error": "submit is POST"}
+            if self.resources is None:
+                return 404, {"error": "remote submission disabled "
+                                      "(server started without resources)"}
+            # The custom header blocks browser CSRF (cross-origin requests
+            # cannot attach it without a CORS preflight this server never
+            # approves); class paths are allowlisted because a dotted path
+            # is arbitrary code execution on untrusted input.
+            if headers.get("x-storm-tpu-submit") != "1":
+                return 403, {"error": "missing X-Storm-Tpu-Submit: 1 header"}
+            definition = body.get("definition")
+            name = body.get("name")
+            if not name or not isinstance(definition, dict):
+                return 400, {"error": 'need {"name": ..., "definition": {...}}'}
+            if name in self._runtimes():
+                return 400, {"error": f"topology {name!r} already running"}
+            from storm_tpu.config import Config as _Config
+            from storm_tpu.flux import FluxError, load_topology
+
+            try:
+                topo = await asyncio.to_thread(
+                    load_topology, definition, dict(self.resources),
+                    self.submit_class_prefixes)
+                await self.cluster.submit(name, _Config(), topo)
+            except (FluxError, ValueError, TypeError) as e:
+                # malformed definitions, bad wiring, and the duplicate-name
+                # race are all client errors, not server faults
+                return 400, {"error": str(e)}
+            return 200, {"status": "SUBMITTED", "name": name,
+                         "components": sorted(topo.specs)}
         if path.startswith("/api/v1/drpc/"):
             if method != "POST":
                 return 405, {"error": "drpc is POST"}
