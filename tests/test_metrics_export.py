@@ -131,3 +131,22 @@ def test_prometheus_endpoint(run):
             await cluster.shutdown()
 
     run(go(), timeout=60)
+
+
+def test_rate_gauges_published(run):
+    async def go():
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("m", Config(), _topology())
+        # wait past two sweep intervals so a delta exists
+        deadline = asyncio.get_event_loop().time() + 30
+        while asyncio.get_event_loop().time() < deadline:
+            snap = rt.metrics.snapshot()
+            if snap.get("echo", {}).get("execute_rate", 0) > 0:
+                break
+            await asyncio.sleep(0.25)
+        snap = rt.metrics.snapshot()
+        assert snap["echo"]["execute_rate"] > 0  # TrickleSpout feeds ~100/s
+        assert "ack_rate" in snap["spout"]
+        await cluster.shutdown()
+
+    run(go(), timeout=60)
