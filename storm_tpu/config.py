@@ -149,6 +149,9 @@ class TopologyConfig:
     tick_interval_s: float = 0.0  # 0 = no tick tuples
     checkpoint_interval_s: float = 5.0  # stateful-bolt checkpoint cadence
     state_dir: str = ""  # durable bolt-state dir; "" = in-memory backend
+    # Per-task resource hints for resource-aware dist placement (Storm's
+    # RAS): {"component-id": {"memory_mb": N, "cpu": pct}}.
+    component_resources: dict = field(default_factory=dict)
 
 
 @dataclass

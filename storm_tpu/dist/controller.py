@@ -39,9 +39,14 @@ class DistCluster:
         n_workers: int = 2,
         addrs: Optional[List[str]] = None,
         env: Optional[dict] = None,
+        worker_resources: Optional[dict] = None,
     ) -> None:
         """Spawn ``n_workers`` local worker processes, or attach to
-        ``addrs`` (["host:port", ...]) if given."""
+        ``addrs`` (["host:port", ...]) if given. ``worker_resources``
+        is each worker's capacity for resource-aware placement
+        (default {"memory_mb": 4096, "cpu": 400})."""
+        self._worker_resources = worker_resources or {
+            "memory_mb": 4096.0, "cpu": 400.0}
         self.procs: List[Optional[subprocess.Popen]] = []
         self.clients: List[WorkerClient] = []
         self._stderr_files: List = []
@@ -135,6 +140,70 @@ class DistCluster:
                 c.control("start_spouts")
         return placement
 
+    @staticmethod
+    def plan_placement(
+        demands: "Dict[str, dict]",
+        worker_capacities: "List[dict]",
+    ) -> Dict[str, int]:
+        """Resource-aware placement (Storm's RAS): worst-fit-decreasing
+        bin-packing — biggest demands first, each onto the worker with the
+        most remaining memory, which balances load across workers.
+
+        ``demands``: component -> {"memory_mb", "cpu", "is_spout"} (already
+        multiplied by parallelism). ``worker_capacities``: one
+        {"memory_mb", "cpu"} per worker; a missing capacity key means
+        unconstrained. Spouts place first and prefer worker 0 (the ack
+        ledger lives with its spout) when it fits. Zero-demand components
+        spread by assignment count (hinting one component must not collapse
+        the rest onto a single worker). Raises ValueError when a component
+        fits nowhere — Storm's RAS refuses rather than oversubscribes.
+        """
+        inf = float("inf")
+        remaining = [{"memory_mb": float(c.get("memory_mb", inf)),
+                      "cpu": float(c.get("cpu", inf))}
+                     for c in worker_capacities]
+        counts = [0] * len(remaining)
+        placement: Dict[str, int] = {}
+        order = sorted(
+            demands.items(),
+            key=lambda kv: (not kv[1].get("is_spout", False),
+                            -kv[1].get("memory_mb", 0.0),
+                            -kv[1].get("cpu", 0.0)),
+        )
+
+        def fits(w: int, d: dict) -> bool:
+            return (remaining[w]["memory_mb"] >= d.get("memory_mb", 0.0)
+                    and remaining[w]["cpu"] >= d.get("cpu", 0.0))
+
+        def take(w: int, d: dict, cid: str) -> None:
+            remaining[w]["memory_mb"] -= d.get("memory_mb", 0.0)
+            remaining[w]["cpu"] -= d.get("cpu", 0.0)
+            counts[w] += 1
+            placement[cid] = w
+
+        for cid, d in order:
+            zero = not d.get("memory_mb") and not d.get("cpu")
+            if d.get("is_spout") and fits(0, d):
+                take(0, d, cid)
+                continue
+            if zero:
+                # spread by assignment count, not remaining memory
+                w = min(range(len(remaining)), key=lambda i: (counts[i], i))
+                take(w, d, cid)
+                continue
+            best = None
+            for w_ in range(len(remaining)):
+                if fits(w_, d):
+                    if best is None or (remaining[w_]["memory_mb"]
+                                        > remaining[best]["memory_mb"]):
+                        best = w_
+            if best is None:
+                raise ValueError(
+                    f"component {cid!r} (demand {d}) fits no worker "
+                    f"(remaining: {remaining})")
+            take(best, d, cid)
+        return placement
+
     def _auto_place(self, cfg: Config, builder: str) -> Dict[str, int]:
         """Spouts on worker 0 (ledger lives with its spout); bolts
         round-robin over the rest (or worker 0 when single-worker)."""
@@ -147,6 +216,29 @@ class DistCluster:
         build = (build_multi_model_topology if builder == "multi"
                  else build_standard_topology)
         topo = build(cfg, MemoryBroker())
+        hints = dict(getattr(cfg.topology, "component_resources", {}) or {})
+        unknown = set(hints) - set(topo.specs)
+        if unknown:
+            raise ValueError(
+                f"component_resources for unknown components {sorted(unknown)} "
+                f"(topology has {sorted(topo.specs)})")
+        for spec in topo.specs.values():
+            if spec.component_id not in hints and getattr(spec, "resources", None):
+                hints[spec.component_id] = spec.resources
+        if hints:
+            # Resource-aware path (Storm's RAS): demands are per-task hints
+            # times parallelism; unhinted components count as zero-demand
+            # and pack wherever capacity remains.
+            demands = {}
+            for spec in topo.specs.values():
+                h = hints.get(spec.component_id, {})
+                demands[spec.component_id] = {
+                    "memory_mb": float(h.get("memory_mb", 0.0)) * spec.parallelism,
+                    "cpu": float(h.get("cpu", 0.0)) * spec.parallelism,
+                    "is_spout": spec.is_spout,
+                }
+            caps = self._worker_capacities()
+            return self.plan_placement(demands, caps)
         placement: Dict[str, int] = {}
         n = len(self.clients)
         rr = 1 % n
@@ -157,6 +249,9 @@ class DistCluster:
                 placement[spec.component_id] = rr
                 rr = (rr + 1) % n or (1 % n)
         return placement
+
+    def _worker_capacities(self) -> "List[dict]":
+        return [dict(self._worker_resources) for _ in self.clients]
 
     # ---- observation ---------------------------------------------------------
 

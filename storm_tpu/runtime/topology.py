@@ -41,6 +41,10 @@ class ComponentSpec:
     parallelism: int
     is_spout: bool
     inputs: List[Subscription] = field(default_factory=list)
+    #: resource hints for placement (Storm's Resource Aware Scheduler
+    #: surface: setMemoryLoad/setCPULoad). Per TASK; a placer multiplies
+    #: by parallelism.
+    resources: dict = field(default_factory=dict)
 
 
 class _Declarer:
@@ -65,6 +69,17 @@ class _Declarer:
 
     def global_grouping(self, source: str, stream: str = "default") -> "_Declarer":
         return self.grouping(source, G.GlobalGrouping(), stream)
+
+    def set_memory_load(self, mb: float) -> "_Declarer":
+        """Per-task memory hint (Storm's ``setMemoryLoad``) for
+        resource-aware placement."""
+        self._spec.resources["memory_mb"] = float(mb)
+        return self
+
+    def set_cpu_load(self, pct: float) -> "_Declarer":
+        """Per-task CPU hint (Storm's ``setCPULoad``; 100 = one core)."""
+        self._spec.resources["cpu"] = float(pct)
+        return self
 
 
 @dataclass
