@@ -33,6 +33,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import json
 import time
 import zlib
 from dataclasses import dataclass
@@ -234,12 +235,17 @@ def encode_record_batch(
     records: List[Tuple[Optional[bytes], bytes]],
     ts_ms: int,
     base_offset: int = 0,
+    compression: Optional[str] = None,
 ) -> bytes:
-    """[(key, value)] -> one RecordBatch (magic 2, no compression, no
-    producer id / transactions). CRC32C (Castagnoli) covers everything
-    after the crc field, computed by the native layer when built."""
+    """[(key, value)] -> one RecordBatch (magic 2; ``compression='gzip'``
+    gzips the records block, attrs codec bit 1). CRC32C (Castagnoli)
+    covers everything after the crc field, computed by the native layer
+    when built."""
     from storm_tpu.native import crc32c
 
+    if compression not in (None, "gzip"):
+        raise KafkaProtocolError(
+            f"unsupported compression {compression!r} (only gzip)")
     body = bytearray()
     for i, (key, value) in enumerate(records):
         rec = bytearray()
@@ -257,8 +263,15 @@ def encode_record_batch(
         _write_varint(body, len(rec))
         body += rec
 
+    payload = bytes(body)
+    attrs = 0
+    if compression == "gzip":
+        import gzip as _gzip
+
+        payload = _gzip.compress(payload)
+        attrs = 1  # codec bits: gzip
     after_crc = Writer()
-    after_crc.i16(0)  # attributes: no codec, create-time, not transactional
+    after_crc.i16(attrs)
     after_crc.i32(len(records) - 1)  # lastOffsetDelta
     after_crc.i64(ts_ms)  # baseTimestamp
     after_crc.i64(ts_ms)  # maxTimestamp
@@ -266,7 +279,7 @@ def encode_record_batch(
     after_crc.i16(-1)  # producerEpoch
     after_crc.i32(-1)  # baseSequence
     after_crc.i32(len(records))
-    after_crc.raw(bytes(body))
+    after_crc.raw(payload)
     crc = crc32c(bytes(after_crc.buf))
 
     batch = Writer()
@@ -543,17 +556,23 @@ class KafkaWireClient:
         acks: int = 1,
         timeout_ms: int = 30000,
         message_format: str = "v1",
+        compression: Optional[str] = None,
     ) -> int:
         """Returns the base offset assigned by the broker.
 
         ``message_format='v2'`` ships a KIP-98 RecordBatch over Produce v3
-        (CRC32C, varint records) — what modern brokers store natively;
-        'v1' keeps the 0.11-era message set the reference ran against."""
+        (CRC32C, varint records; optional gzip) — what modern brokers store
+        natively; 'v1' keeps the 0.11-era message set the reference ran
+        against."""
         ts_ms = int(time.time() * 1e3)
         if message_format == "v2":
-            payload = encode_record_batch(records, ts_ms)
+            payload = encode_record_batch(records, ts_ms,
+                                          compression=compression)
             api_version = 3
         elif message_format == "v1":
+            if compression:
+                raise KafkaProtocolError(
+                    "compression is only wired for message_format='v2'")
             payload = encode_message_set(records, ts_ms)
             api_version = 2
         else:
@@ -721,6 +740,141 @@ class KafkaWireClient:
 # ---- MemoryBroker-surface adapter -------------------------------------------
 
 
+class GroupMembership:
+    """Kafka consumer-group coordination (JoinGroup/SyncGroup/Heartbeat/
+    LeaveGroup v0) — dynamic partition assignment across cooperating
+    consumers, the modern replacement for the reference's ZooKeeper-based
+    assignment (MainTopology.java:96-99).
+
+    ``join()`` runs the join->sync cycle (the elected leader computes a
+    range assignment over ``topics``) and returns this member's
+    ``[(topic, partition), ...]``. ``heartbeat()`` returns False when the
+    group is rebalancing — call ``join()`` again (positions should then be
+    re-resolved per the offsets policy). ``leave()`` exits cleanly,
+    triggering a rebalance for the survivors.
+    """
+
+    PROTOCOL = "range"
+
+    def __init__(self, client: "KafkaWireClient", group: str,
+                 topics: List[str], session_timeout_ms: int = 10000) -> None:
+        self.client = client
+        self.group = group
+        self.topics = list(topics)
+        self.session_timeout_ms = session_timeout_ms
+        self.member_id = ""
+        self.generation = -1
+        self.is_leader = False
+
+    # v0 wire bodies ----------------------------------------------------------
+
+    def _coordinator(self):
+        # the stub (and a single-broker cluster) coordinates on bootstrap
+        return self.client.bootstrap
+
+    def join(self, max_attempts: int = 40) -> List[Tuple[str, int]]:
+        for _ in range(max_attempts):
+            w = Writer()
+            w.string(self.group).i32(self.session_timeout_ms)
+            w.string(self.member_id).string("consumer")
+            w.i32(1)
+            w.string(self.PROTOCOL)
+            w.bytes_(",".join(self.topics).encode())
+            r = self.client._request(self._coordinator(), 11, 0, bytes(w.buf))
+            err = r.i16()
+            if err:
+                # retryable coordination errors: evicted member (25 — rejoin
+                # as new), coordinator moving/loading (14/15/16), rebalance
+                # (27). Anything else is a real fault.
+                if err == 25:
+                    self.member_id = ""
+                if err in (14, 15, 16, 25, 27):
+                    time.sleep(0.05)
+                    continue
+                raise KafkaProtocolError(f"JoinGroup error {err}")
+            self.generation = r.i32()
+            r.string()  # protocol
+            leader = r.string()
+            self.member_id = r.string()
+            members = {}
+            for _ in range(r.i32()):
+                mid = r.string()
+                members[mid] = r.bytes_() or b""
+            self.is_leader = leader == self.member_id
+            assignments: Dict[str, bytes] = {}
+            if self.is_leader:
+                assignments = self._range_assign(sorted(members))
+            # sync; on REBALANCE_IN_PROGRESS the generation is still valid
+            # and only the leader's sync is pending — retry the SYNC, not
+            # the whole join (rejoining would never let a follower settle
+            # while its own retry loop holds the thread)
+            err, blob = 27, b""
+            for _ in range(20):
+                w = Writer()
+                w.string(self.group).i32(self.generation).string(self.member_id)
+                w.i32(len(assignments))
+                for mid, ablob in assignments.items():
+                    w.string(mid)
+                    w.bytes_(ablob)
+                r = self.client._request(self._coordinator(), 14, 0, bytes(w.buf))
+                err = r.i16()
+                blob = r.bytes_()
+                if err != 27:
+                    break
+                time.sleep(0.05)
+            if err == 27:
+                continue  # leader still absent after patience: rejoin
+            if err:
+                self.member_id = self.member_id if err != 25 else ""
+                time.sleep(0.05)
+                continue
+            return self._decode_assignment(blob or b"")
+        raise KafkaProtocolError(
+            f"group {self.group!r} did not stabilize in {max_attempts} attempts")
+
+    def _range_assign(self, member_ids: List[str]) -> Dict[str, bytes]:
+        """Contiguous ranges per topic over the sorted member list."""
+        per_member: Dict[str, List[Tuple[str, int]]] = {m: [] for m in member_ids}
+        for topic in self.topics:
+            n_parts = self.client.partitions_for(topic)
+            n_members = len(member_ids)
+            base, extra = divmod(n_parts, n_members)
+            p = 0
+            for i, m in enumerate(member_ids):
+                take = base + (1 if i < extra else 0)
+                for _ in range(take):
+                    per_member[m].append((topic, p))
+                    p += 1
+        return {m: self._encode_assignment(parts)
+                for m, parts in per_member.items()}
+
+    @staticmethod
+    def _encode_assignment(parts: List[Tuple[str, int]]) -> bytes:
+        return json.dumps(sorted(parts)).encode()
+
+    @staticmethod
+    def _decode_assignment(blob: bytes) -> List[Tuple[str, int]]:
+        if not blob:
+            return []
+        return [(t, int(p)) for t, p in json.loads(blob.decode())]
+
+    def heartbeat(self) -> bool:
+        """True = group stable; False = rebalance in progress (rejoin)."""
+        w = Writer()
+        w.string(self.group).i32(self.generation).string(self.member_id)
+        r = self.client._request(self._coordinator(), 12, 0, bytes(w.buf))
+        return r.i16() == 0
+
+    def leave(self) -> None:
+        if not self.member_id:
+            return
+        w = Writer()
+        w.string(self.group).string(self.member_id)
+        self.client._request(self._coordinator(), 13, 0, bytes(w.buf))
+        self.member_id = ""
+        self.generation = -1
+
+
 class KafkaWireBroker:
     """Real-Kafka backend with the MemoryBroker surface, so BrokerSpout /
     BrokerSink work unchanged (``BrokerConfig.kind='kafka'``)."""
@@ -730,9 +884,11 @@ class KafkaWireBroker:
     blocking = True
 
     def __init__(self, bootstrap: str, client_id: str = "storm-tpu",
-                 message_format: str = "v1") -> None:
+                 message_format: str = "v1",
+                 compression: Optional[str] = None) -> None:
         self.client = KafkaWireClient(bootstrap, client_id)
         self.message_format = message_format
+        self.compression = compression
         self._rr = 0
         # Decoded-but-not-yet-returned tail of the last wire fetch, per
         # partition: a 1MB fetch can decode far more than max_records, and
@@ -760,7 +916,8 @@ class KafkaWireBroker:
                 partition = self._rr % n
                 self._rr += 1
         off = self.client.produce(topic, partition, [(key, value)],
-                                  message_format=self.message_format)
+                                  message_format=self.message_format,
+                                  compression=self.compression)
         return partition, off
 
     def fetch(self, topic, partition, offset, max_records=512):

@@ -194,8 +194,13 @@ class DistCluster:
             best = None
             for w_ in range(len(remaining)):
                 if fits(w_, d):
-                    if best is None or (remaining[w_]["memory_mb"]
-                                        > remaining[best]["memory_mb"]):
+                    # worst fit on memory, then cpu, then fewest assignments
+                    # (cpu-only workloads must still spread)
+                    key = (remaining[w_]["memory_mb"], remaining[w_]["cpu"],
+                           -counts[w_])
+                    if best is None or key > (remaining[best]["memory_mb"],
+                                              remaining[best]["cpu"],
+                                              -counts[best]):
                         best = w_
             if best is None:
                 raise ValueError(
@@ -222,6 +227,12 @@ class DistCluster:
             raise ValueError(
                 f"component_resources for unknown components {sorted(unknown)} "
                 f"(topology has {sorted(topo.specs)})")
+        for cid, h in hints.items():
+            bad_keys = set(h) - {"memory_mb", "cpu"}
+            if bad_keys:
+                raise ValueError(
+                    f"component_resources[{cid!r}] has unknown keys "
+                    f"{sorted(bad_keys)} (allowed: memory_mb, cpu)")
         for spec in topo.specs.values():
             if spec.component_id not in hints and getattr(spec, "resources", None):
                 hints[spec.component_id] = spec.resources

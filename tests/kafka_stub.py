@@ -2,9 +2,13 @@
 sockets — the test double for KafkaWireClient/KafkaWireBroker (SURVEY.md §4:
 fake broker for topology tests without external Kafka).
 
-Implements the exact API subset the client uses: Metadata v0, Produce v2,
-Fetch v2, ListOffsets v0, FindCoordinator v0, OffsetCommit v2,
-OffsetFetch v1. Single-node, message-format v1, no compression."""
+Implements the exact API subset the client uses: Metadata v0, Produce
+v2/v3 (message sets and KIP-98 record batches, gzip included), Fetch v2
+(optionally serving magic-2 batches via ``serve_batches``), ListOffsets
+v0, FindCoordinator v0, OffsetCommit v2, OffsetFetch v1, and
+consumer-group coordination — JoinGroup/SyncGroup/Heartbeat/LeaveGroup v0
+with immediate-join semantics and session-timeout expiry of dead members.
+Single node."""
 
 from __future__ import annotations
 
@@ -32,6 +36,11 @@ class KafkaStubBroker:
         self._logs: Dict[Tuple[str, int], List[Tuple[Optional[bytes], bytes, float]]] = {}
         self._topics: Dict[str, int] = {}
         self._commits: Dict[Tuple[str, str, int], int] = {}
+        # consumer groups: group -> {"generation", "members": {member_id:
+        # metadata}, "leader", "assignments": {member_id: bytes},
+        # "stable": set(member ids that joined the current generation)}
+        self._groups: Dict[str, dict] = {}
+        self._member_seq = 0
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -126,6 +135,14 @@ class KafkaStubBroker:
             return self._offset_commit(r)
         if api == 9:
             return self._offset_fetch(r)
+        if api == 11:
+            return self._join_group(r)
+        if api == 14:
+            return self._sync_group(r)
+        if api == 12:
+            return self._heartbeat(r)
+        if api == 13:
+            return self._leave_group(r)
         raise RuntimeError(f"stub does not implement api {api}")
 
     def _metadata(self, r: Reader) -> bytes:
@@ -283,4 +300,146 @@ class KafkaStubBroker:
                 with self._lock:
                     off = self._commits.get((group, topic, pid), -1)
                 w.i32(pid).i64(off).string(None).i16(0)
+        return bytes(w.buf)
+
+    # ---- consumer-group coordination (JoinGroup/SyncGroup/Heartbeat/Leave) ---
+    # v0 request formats; "immediate join" semantics: a join bumps the
+    # generation and existing members discover via REBALANCE_IN_PROGRESS
+    # heartbeats, then rejoin — the real protocol flow without the broker's
+    # join-window timers.
+
+    _REBALANCE_IN_PROGRESS = 27
+    _ILLEGAL_GENERATION = 22
+    _UNKNOWN_MEMBER = 25
+
+    def _group(self, gid: str) -> dict:
+        g = self._groups.setdefault(gid, {
+            "generation": 0, "members": {}, "leader": None,
+            "assignments": {}, "stable": set(), "deadlines": {},
+            "sessions": {},
+        })
+        # expire members that vanished without leave(): a dead member must
+        # not wedge the group in permanent rebalance
+        now = time.time()
+        dead = [m for m, dl in g.get("deadlines", {}).items() if dl < now]
+        for m in dead:
+            g["members"].pop(m, None)
+            g["stable"].discard(m)
+            g["assignments"].pop(m, None)
+            g["deadlines"].pop(m, None)
+            g["sessions"].pop(m, None)
+            if g["leader"] == m:
+                g["leader"] = next(iter(g["members"]), None)
+        if dead and g["members"]:
+            g["generation"] += 1
+            g["stable"] = set()
+            g["assignments"] = {}
+        return g
+
+    def _join_group(self, r: Reader) -> bytes:
+        gid = r.string()
+        session_ms = r.i32()
+        member = r.string() or ""
+        r.string()  # protocol_type
+        protos = []
+        for _ in range(r.i32()):
+            protos.append((r.string(), r.bytes_() or b""))
+        with self._lock:
+            g = self._group(gid)
+            if not member:
+                self._member_seq += 1
+                member = f"member-{self._member_seq}"
+            fresh = member not in g["members"]
+            was_stable = g["members"] and g["stable"] == set(g["members"])
+            g["members"][member] = protos[0][1] if protos else b""
+            if fresh or was_stable:
+                # a NEW member, or a stable member voluntarily rejoining,
+                # starts a rebalance; rejoins DURING a rebalance just count
+                # toward completion (bumping again would livelock)
+                g["generation"] += 1
+                g["stable"] = {member}
+                g["assignments"] = {}
+            else:
+                g["stable"].add(member)
+            g["sessions"][member] = session_ms / 1e3
+            g["deadlines"][member] = time.time() + session_ms / 1e3
+            if g["leader"] not in g["members"]:
+                g["leader"] = member
+            leader = g["leader"]
+            gen = g["generation"]
+            members = dict(g["members"]) if member == leader else {}
+            proto_name = protos[0][0] if protos else "range"
+        w = Writer()
+        w.i16(0).i32(gen).string(proto_name).string(leader).string(member)
+        w.i32(len(members))
+        for mid, meta in members.items():
+            w.string(mid)
+            w.bytes_(meta)
+        return bytes(w.buf)
+
+    def _sync_group(self, r: Reader) -> bytes:
+        gid = r.string()
+        gen = r.i32()
+        member = r.string()
+        assignments = {}
+        for _ in range(r.i32()):
+            mid = r.string()
+            assignments[mid] = r.bytes_() or b""
+        w = Writer()
+        with self._lock:
+            g = self._group(gid)
+            if member not in g["members"]:
+                w.i16(self._UNKNOWN_MEMBER).bytes_(b"")
+                return bytes(w.buf)
+            if gen != g["generation"]:
+                w.i16(self._ILLEGAL_GENERATION).bytes_(b"")
+                return bytes(w.buf)
+            if assignments:  # the leader distributes
+                g["assignments"] = assignments
+            g["stable"].add(member)
+            mine = g["assignments"].get(member)
+        if mine is None:
+            w.i16(self._REBALANCE_IN_PROGRESS).bytes_(b"")
+        else:
+            w.i16(0).bytes_(mine)
+        return bytes(w.buf)
+
+    def _heartbeat(self, r: Reader) -> bytes:
+        gid = r.string()
+        gen = r.i32()
+        member = r.string()
+        w = Writer()
+        with self._lock:
+            g = self._group(gid)
+            if member not in g["members"]:
+                w.i16(self._UNKNOWN_MEMBER)
+            else:
+                session_s = g["sessions"].get(member)
+                if session_s is not None:
+                    # a heartbeat renews the member's session window
+                    g["deadlines"][member] = time.time() + session_s
+                if gen != g["generation"] or g["stable"] != set(g["members"]):
+                    w.i16(self._REBALANCE_IN_PROGRESS)
+                else:
+                    w.i16(0)
+        return bytes(w.buf)
+
+    def _leave_group(self, r: Reader) -> bytes:
+        gid = r.string()
+        member = r.string()
+        with self._lock:
+            g = self._group(gid)
+            g["members"].pop(member, None)
+            g["stable"].discard(member)
+            g["assignments"].pop(member, None)
+            if g["members"]:
+                g["generation"] += 1
+                g["stable"] = set()
+                g["assignments"] = {}
+                if g["leader"] == member:
+                    g["leader"] = next(iter(g["members"]))
+            else:
+                g["leader"] = None
+        w = Writer()
+        w.i16(0)
         return bytes(w.buf)

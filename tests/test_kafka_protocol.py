@@ -296,3 +296,150 @@ def test_wire_client_produces_and_fetches_v2_batches():
         assert [r.offset for r in got] == list(range(5))
     finally:
         stub.close()
+
+
+def test_record_batch_gzip_roundtrip():
+    from storm_tpu.connectors.kafka_protocol import (
+        decode_record_batch,
+        encode_record_batch,
+    )
+
+    records = [(None, b"x" * 400)] * 10  # compressible
+    plain = encode_record_batch(records, ts_ms=0)
+    gz = encode_record_batch(records, ts_ms=0, compression="gzip")
+    assert len(gz) < len(plain) / 3
+    out, consumed = decode_record_batch("t", 0, gz, verify_crc=True)
+    assert consumed == len(gz)
+    assert [(r.key, r.value) for r in out] == records
+
+
+def test_wire_client_gzip_v2_over_socket():
+    from kafka_stub import KafkaStubBroker
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+    stub = KafkaStubBroker(partitions=1)
+    try:
+        broker = KafkaWireBroker(f"127.0.0.1:{stub.port}",
+                                 message_format="v2", compression="gzip")
+        for i in range(4):
+            broker.produce("gz", f"msg-{i}" * 50)
+        got = broker.fetch("gz", 0, 0, max_records=10)
+        assert [r.value for r in got] == [f"msg-{i}".encode() * 50 for i in range(4)]
+    finally:
+        stub.close()
+
+
+# ---- consumer-group coordination ---------------------------------------------
+
+
+def _stabilize(members, timeout=20.0):
+    """One loop per member, like real consumers: heartbeat; on rebalance,
+    rejoin. Stops once every member is stable with an assignment."""
+    import threading
+    import time as _time
+
+    assigns: dict = {}
+    done = threading.Event()
+
+    def run(m):
+        end = _time.monotonic() + timeout
+        while not done.is_set() and _time.monotonic() < end:
+            try:
+                if m not in assigns or m.generation < 0 or not m.heartbeat():
+                    assigns[m] = m.join(max_attempts=5)
+                else:
+                    _time.sleep(0.02)
+            except Exception:
+                _time.sleep(0.05)
+
+    threads = [threading.Thread(target=run, args=(m,)) for m in members]
+    for t in threads:
+        t.start()
+    end = _time.monotonic() + timeout
+    while _time.monotonic() < end:
+        if all(m in assigns for m in members) and \
+                all(m.heartbeat() for m in members):
+            break
+        _time.sleep(0.05)
+    done.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert all(m in assigns for m in members), "members never stabilized"
+    assert all(m.heartbeat() for m in members)
+    return [assigns[m] for m in members]
+
+
+def test_group_membership_splits_and_rebalances():
+    """Two members split partitions via the join/sync protocol; one leaving
+    rebalances the survivor onto everything — over real sockets."""
+    from kafka_stub import KafkaStubBroker
+    from storm_tpu.connectors.kafka_protocol import GroupMembership, KafkaWireClient
+
+    stub = KafkaStubBroker(partitions=4)
+    try:
+        c1 = KafkaWireClient(f"127.0.0.1:{stub.port}")
+        c2 = KafkaWireClient(f"127.0.0.1:{stub.port}")
+        c1.partitions_for("t")  # create the topic
+        m1 = GroupMembership(c1, "g", ["t"])
+        m2 = GroupMembership(c2, "g", ["t"])
+
+        (a1,) = _stabilize([m1])
+        assert sorted(a1) == [("t", 0), ("t", 1), ("t", 2), ("t", 3)]
+
+        a1, a2 = _stabilize([m1, m2])
+        assert sorted(a1 + a2) == [("t", 0), ("t", 1), ("t", 2), ("t", 3)]
+        assert len(a1) == len(a2) == 2
+        assert not set(a1) & set(a2)
+
+        # member 2 leaves: survivor rebalances onto all partitions
+        m2.leave()
+        assert not m1.heartbeat()
+        (a1,) = _stabilize([m1])
+        assert sorted(a1) == [("t", 0), ("t", 1), ("t", 2), ("t", 3)]
+        m1.leave()
+    finally:
+        stub.close()
+
+
+def test_group_membership_three_members_range():
+    from kafka_stub import KafkaStubBroker
+    from storm_tpu.connectors.kafka_protocol import GroupMembership, KafkaWireClient
+
+    stub = KafkaStubBroker(partitions=5)
+    try:
+        clients = [KafkaWireClient(f"127.0.0.1:{stub.port}") for _ in range(3)]
+        clients[0].partitions_for("t")
+        members = [GroupMembership(c, "g3", ["t"]) for c in clients]
+        assigns = _stabilize(members)
+        allp = sorted(p for a in assigns for p in a)
+        assert allp == [("t", i) for i in range(5)]
+        sizes = sorted(len(a) for a in assigns)
+        assert sizes == [1, 2, 2]  # 5 partitions over 3 members, range-style
+    finally:
+        stub.close()
+
+
+def test_group_dead_member_expires():
+    """A member that vanishes without leave() is expired by its session
+    timeout, unwedging the survivors."""
+    import time as _time
+
+    from kafka_stub import KafkaStubBroker
+    from storm_tpu.connectors.kafka_protocol import GroupMembership, KafkaWireClient
+
+    stub = KafkaStubBroker(partitions=2)
+    try:
+        c1 = KafkaWireClient(f"127.0.0.1:{stub.port}")
+        c2 = KafkaWireClient(f"127.0.0.1:{stub.port}")
+        c1.partitions_for("t")
+        m1 = GroupMembership(c1, "g", ["t"], session_timeout_ms=500)
+        m2 = GroupMembership(c2, "g", ["t"], session_timeout_ms=500)
+        a1, a2 = _stabilize([m1, m2])
+        assert len(a1) == len(a2) == 1
+        # m2 dies silently (no leave, no heartbeats)
+        _time.sleep(0.8)
+        assert not m1.heartbeat()  # expiry triggered a rebalance
+        (a1,) = _stabilize([m1])
+        assert sorted(a1) == [("t", 0), ("t", 1)]
+    finally:
+        stub.close()

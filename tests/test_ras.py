@@ -147,3 +147,28 @@ def test_unknown_hint_key_rejected():
     cfg.topology.component_resources = {"inference_bolt": {"memory_mb": 10}}
     with pytest.raises(ValueError, match="unknown components"):
         cluster._auto_place(cfg, "standard")
+
+
+def test_cpu_only_hints_spread():
+    demands = {f"b{i}": {"cpu": 100} for i in range(4)}
+    p = plan(demands, _caps(2, memory_mb=4096, cpu=400))
+    # memory never changes; the cpu/count tie-break must still spread
+    from collections import Counter
+
+    assert sorted(Counter(p.values()).values()) == [2, 2]
+
+
+def test_unknown_resource_key_rejected():
+    from storm_tpu.config import Config
+
+    class FakeClient:
+        def __init__(self, target):
+            self.target = target
+
+    cluster = DistCluster.__new__(DistCluster)
+    cluster.clients = [FakeClient("a:1")]
+    cluster._worker_resources = {"memory_mb": 4096.0, "cpu": 400.0}
+    cfg = Config()
+    cfg.topology.component_resources = {"inference-bolt": {"mem_mb": 400}}
+    with pytest.raises(ValueError, match="unknown keys"):
+        cluster._auto_place(cfg, "standard")
