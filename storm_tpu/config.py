@@ -114,8 +114,19 @@ class OffsetsConfig:
     policy: str = "latest"  # 'latest' | 'earliest' | 'resume'
     max_behind: Optional[int] = 0  # drop records more than N offsets behind; None = unbounded
     group_id: Optional[str] = None  # None = fresh random group per run (reference behavior)
+    # True: partitions come from Kafka consumer-group coordination
+    # (JoinGroup/SyncGroup) instead of static task-index assignment —
+    # spout tasks then cooperate with ANY consumer sharing the group.
+    # Requires a wire-protocol broker (KafkaWireBroker).
+    group_protocol: bool = False
 
     def __post_init__(self) -> None:
+        if self.group_protocol and not self.group_id:
+            # every task would otherwise mint its own uuid group and be
+            # assigned ALL partitions -> N-fold duplicate consumption
+            raise ValueError(
+                "offsets.group_protocol requires an explicit group_id "
+                "(tasks must share one group to split partitions)")
         if self.policy not in ("latest", "earliest", "resume"):
             raise ValueError(f"unknown offsets policy {self.policy!r}")
 

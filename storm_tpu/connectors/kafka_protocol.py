@@ -756,6 +756,60 @@ class GroupMembership:
 
     PROTOCOL = "range"
 
+    # ConsumerProtocol v0 (Kafka's cross-client subscription/assignment
+    # format): interop with standard consumers requires speaking it — a
+    # foreign leader's assignment must parse here, and our leader's
+    # assignments must parse in kafka-python/Java clients.
+
+    @staticmethod
+    def _encode_subscription(topics: List[str]) -> bytes:
+        w = Writer()
+        w.i16(0)
+        w.i32(len(topics))
+        for t in topics:
+            w.string(t)
+        w.bytes_(b"")  # userdata
+        return bytes(w.buf)
+
+    @staticmethod
+    def _decode_subscription(blob: bytes) -> List[str]:
+        r = Reader(blob)
+        r.i16()
+        return [r.string() for _ in range(r.i32())]
+
+    @staticmethod
+    def _encode_assignment(parts: List[Tuple[str, int]]) -> bytes:
+        by_topic: Dict[str, List[int]] = {}
+        for t, p in parts:
+            by_topic.setdefault(t, []).append(p)
+        w = Writer()
+        w.i16(0)
+        w.i32(len(by_topic))
+        for t, ps in sorted(by_topic.items()):
+            w.string(t)
+            w.i32(len(ps))
+            for p in sorted(ps):
+                w.i32(p)
+        w.bytes_(b"")  # userdata
+        return bytes(w.buf)
+
+    @staticmethod
+    def _decode_assignment(blob: bytes) -> List[Tuple[str, int]]:
+        if not blob:
+            return []
+        try:
+            r = Reader(blob)
+            r.i16()
+            out: List[Tuple[str, int]] = []
+            for _ in range(r.i32()):
+                t = r.string()
+                for _ in range(r.i32()):
+                    out.append((t, r.i32()))
+            return sorted(out)
+        except KafkaProtocolError as e:
+            raise KafkaProtocolError(
+                f"undecodable ConsumerProtocol assignment: {e}") from e
+
     def __init__(self, client: "KafkaWireClient", group: str,
                  topics: List[str], session_timeout_ms: int = 10000) -> None:
         self.client = client
@@ -779,7 +833,7 @@ class GroupMembership:
             w.string(self.member_id).string("consumer")
             w.i32(1)
             w.string(self.PROTOCOL)
-            w.bytes_(",".join(self.topics).encode())
+            w.bytes_(self._encode_subscription(self.topics))
             r = self.client._request(self._coordinator(), 11, 0, bytes(w.buf))
             err = r.i16()
             if err:
@@ -803,7 +857,7 @@ class GroupMembership:
             self.is_leader = leader == self.member_id
             assignments: Dict[str, bytes] = {}
             if self.is_leader:
-                assignments = self._range_assign(sorted(members))
+                assignments = self._range_assign(members)
             # sync; on REBALANCE_IN_PROGRESS the generation is still valid
             # and only the leader's sync is pending — retry the SYNC, not
             # the whole join (rejoining would never let a follower settle
@@ -832,31 +886,32 @@ class GroupMembership:
         raise KafkaProtocolError(
             f"group {self.group!r} did not stabilize in {max_attempts} attempts")
 
-    def _range_assign(self, member_ids: List[str]) -> Dict[str, bytes]:
-        """Contiguous ranges per topic over the sorted member list."""
-        per_member: Dict[str, List[Tuple[str, int]]] = {m: [] for m in member_ids}
-        for topic in self.topics:
+    def _range_assign(self, members: Dict[str, bytes]) -> Dict[str, bytes]:
+        """Contiguous ranges per topic, over the members SUBSCRIBED to that
+        topic (parsed from each member's ConsumerProtocol metadata)."""
+        subscriptions: Dict[str, List[str]] = {}
+        for mid, meta in members.items():
+            try:
+                subscriptions[mid] = self._decode_subscription(meta)
+            except KafkaProtocolError:
+                subscriptions[mid] = list(self.topics)  # tolerate odd members
+        all_topics = sorted({t for ts in subscriptions.values() for t in ts})
+        per_member: Dict[str, List[Tuple[str, int]]] = {m: [] for m in members}
+        for topic in all_topics:
+            subscribed = sorted(m for m, ts in subscriptions.items()
+                                if topic in ts)
+            if not subscribed:
+                continue
             n_parts = self.client.partitions_for(topic)
-            n_members = len(member_ids)
-            base, extra = divmod(n_parts, n_members)
+            base, extra = divmod(n_parts, len(subscribed))
             p = 0
-            for i, m in enumerate(member_ids):
+            for i, m in enumerate(subscribed):
                 take = base + (1 if i < extra else 0)
                 for _ in range(take):
                     per_member[m].append((topic, p))
                     p += 1
         return {m: self._encode_assignment(parts)
                 for m, parts in per_member.items()}
-
-    @staticmethod
-    def _encode_assignment(parts: List[Tuple[str, int]]) -> bytes:
-        return json.dumps(sorted(parts)).encode()
-
-    @staticmethod
-    def _decode_assignment(blob: bytes) -> List[Tuple[str, int]]:
-        if not blob:
-            return []
-        return [(t, int(p)) for t, p in json.loads(blob.decode())]
 
     def heartbeat(self) -> bool:
         """True = group stable; False = rebalance in progress (rejoin)."""

@@ -70,10 +70,24 @@ class BrokerSpout(Spout):
         # Random group per run mirrors the reference's UUID consumer id
         # (MainTopology.java:98-99) unless the user pins one for resume.
         self.group = cfg.group_id or f"storm-tpu-{uuid.uuid4()}"
-        n_parts = self.broker.partitions_for(self.topic)
-        self.my_partitions = [
-            p for p in range(n_parts) if p % context.parallelism == context.task_index
-        ]
+        self._membership = None
+        self._last_hb = 0.0
+        if getattr(cfg, "group_protocol", False):
+            client = getattr(self.broker, "client", None)
+            if client is None:
+                raise ValueError(
+                    "offsets.group_protocol needs a wire-protocol broker "
+                    "(KafkaWireBroker); the memory broker has no coordinator")
+            from storm_tpu.connectors.kafka_protocol import GroupMembership
+
+            self._membership = GroupMembership(client, self.group, [self.topic])
+            self.my_partitions: list = []  # assigned on first poll (off-loop)
+        else:
+            n_parts = self.broker.partitions_for(self.topic)
+            self.my_partitions = [
+                p for p in range(n_parts)
+                if p % context.parallelism == context.task_index
+            ]
         self.positions: Dict[int, int] = {}
         self.pending: Dict[Tuple[int, int], Record] = {}
         self.replay: Deque[Record] = collections.deque()
@@ -87,27 +101,72 @@ class BrokerSpout(Spout):
         self._commit_hwm: Dict[int, int] = {}
         self._commit_lock = threading.Lock()
         for p in self.my_partitions:
-            if cfg.policy == "latest":
-                pos = self.broker.latest_offset(self.topic, p)
-            elif cfg.policy == "earliest":
-                pos = self.broker.earliest_offset(self.topic, p)
-            else:  # resume
-                committed = self.broker.committed(self.group, self.topic, p)
-                pos = committed if committed is not None else self.broker.earliest_offset(self.topic, p)
-                # Startup freshness clamp: a resume position more than
-                # max_behind behind the log end jumps forward, dropping the
-                # backlog (Storm's maxOffsetBehind startup behavior that the
-                # reference sets to 0, MainTopology.java:103).
-                if cfg.max_behind is not None:
-                    latest = self.broker.latest_offset(self.topic, p)
-                    if latest - pos > cfg.max_behind:
-                        self.dropped += latest - cfg.max_behind - pos
-                        pos = latest - cfg.max_behind
-            self.positions[p] = pos
+            self.positions[p] = self._initial_position(p)
+
+    def _initial_position(self, p: int) -> int:
+        """Starting offset for a newly-owned partition, honoring the policy
+        INCLUDING the startup freshness clamp (Storm's maxOffsetBehind that
+        the reference sets to 0, MainTopology.java:103) — applied the same
+        whether the partition came from static assignment or a group
+        rebalance handoff."""
+        cfg = self.offsets_cfg
+        if cfg.policy == "latest":
+            return self.broker.latest_offset(self.topic, p)
+        if cfg.policy == "earliest":
+            return self.broker.earliest_offset(self.topic, p)
+        committed = self.broker.committed(self.group, self.topic, p)
+        pos = (committed if committed is not None
+               else self.broker.earliest_offset(self.topic, p))
+        if cfg.max_behind is not None:
+            latest = self.broker.latest_offset(self.topic, p)
+            if latest - pos > cfg.max_behind:
+                self.dropped += latest - cfg.max_behind - pos
+                pos = latest - cfg.max_behind
+        return pos
 
     # ---- Spout API -----------------------------------------------------------
 
+    def _apply_assignment(self, parts: "list[tuple]") -> None:
+        """Adopt a group assignment: (re)position newly-owned partitions per
+        the offsets policy; drop replay entries for revoked ones (another
+        member owns them now — at-least-once tolerates the handoff)."""
+        owned = sorted(p for t, p in parts if t == self.topic)
+        revoked = set(self.my_partitions) - set(owned)
+        self.my_partitions = owned
+        if revoked:
+            keep = []
+            for entry in self.replay:
+                recs = entry if isinstance(entry, list) else [entry]
+                if recs[0].partition not in revoked:
+                    keep.append(entry)
+            self.replay = collections.deque(keep)
+        for p in owned:
+            if p not in self.positions:
+                self.positions[p] = self._initial_position(p)
+        for p in revoked:
+            self.positions.pop(p, None)
+
+    async def _group_poll(self) -> None:
+        """Join on first use; heartbeat ~1/s; rejoin on rebalance."""
+        m = self._membership
+        now = time.monotonic()
+        if m.generation < 0:
+            parts = await asyncio.to_thread(m.join)
+            # off-loop: position resolution does per-partition offset RPCs
+            await asyncio.to_thread(self._apply_assignment, parts)
+            self._last_hb = now
+            return
+        if now - self._last_hb < 1.0:
+            return
+        self._last_hb = now
+        ok = await asyncio.to_thread(m.heartbeat)
+        if not ok:
+            parts = await asyncio.to_thread(m.join)
+            await asyncio.to_thread(self._apply_assignment, parts)
+
     async def next_tuple(self) -> bool:
+        if self._membership is not None:
+            await self._group_poll()
         # Replays first: failed trees take priority over new data.
         if self.replay:
             entry = self.replay.popleft()
@@ -179,6 +238,8 @@ class BrokerSpout(Spout):
         self.pending.pop(msg_id, None)
         if self.offsets_cfg.policy == "resume":
             p, off = self._msg_part_off(msg_id)
+            if self._membership is not None and p not in self.my_partitions:
+                return  # revoked mid-flight: the new owner commits now
             # Commit the contiguous low-water mark for this partition —
             # including failed records awaiting replay, or a restart would
             # skip them and break the resume policy's at-least-once promise.
@@ -202,6 +263,13 @@ class BrokerSpout(Spout):
                 if prev is None or low > prev:
                     self.broker.commit(self.group, self.topic, p, low)
 
+    def close(self) -> None:
+        if getattr(self, "_membership", None) is not None:
+            try:
+                self._membership.leave()  # rebalance survivors promptly
+            except Exception:
+                pass
+
     def _spawn_bg(self, coro) -> None:
         task = asyncio.get_event_loop().create_task(coro)
         self._bg.add(task)
@@ -222,6 +290,10 @@ class BrokerSpout(Spout):
         entry = self.pending.pop(msg_id, None)
         if entry is None:
             return
+        rec0 = entry[0] if isinstance(entry, list) else entry
+        if self._membership is not None and \
+                rec0.partition not in self.my_partitions:
+            return  # revoked mid-flight: the new owner serves it now
         # Queue for replay FIRST, unconditionally: between here and a (possibly
         # asynchronous) staleness verdict the record must be visible to ack()'s
         # low-water commit scan, or a concurrent ack on a later offset would

@@ -192,16 +192,15 @@ class DistCluster:
                 take(w, d, cid)
                 continue
             best = None
+            best_key = None
             for w_ in range(len(remaining)):
                 if fits(w_, d):
                     # worst fit on memory, then cpu, then fewest assignments
                     # (cpu-only workloads must still spread)
                     key = (remaining[w_]["memory_mb"], remaining[w_]["cpu"],
                            -counts[w_])
-                    if best is None or key > (remaining[best]["memory_mb"],
-                                              remaining[best]["cpu"],
-                                              -counts[best]):
-                        best = w_
+                    if best_key is None or key > best_key:
+                        best, best_key = w_, key
             if best is None:
                 raise ValueError(
                     f"component {cid!r} (demand {d}) fits no worker "
