@@ -182,7 +182,7 @@ def run_multi(args) -> None:
     print(json.dumps(result))
 
 
-def build_topology(cfg, broker, batch_cfg, transfer_dtype=None, chunk=0):
+def build_topology(cfg, broker, batch_cfg, transfer_dtype=None, chunk=0, weights="float"):
     from storm_tpu.config import Config, ModelConfig, OffsetsConfig, ShardingConfig
     from storm_tpu.connectors import BrokerSink, BrokerSpout
     from storm_tpu.infer import InferenceBolt
@@ -196,6 +196,7 @@ def build_topology(cfg, broker, batch_cfg, transfer_dtype=None, chunk=0):
         input_shape=cfg["input_shape"],
         num_classes=cfg["num_classes"],
         transfer_dtype=transfer_dtype,
+        weights=weights,
     )
     tb = TopologyBuilder()
     tb.set_spout(
@@ -279,6 +280,10 @@ def main() -> None:
     ap.add_argument("--latency-seconds", type=float, default=8.0)
     ap.add_argument("--max-wait-ms", type=float, default=25.0)
     ap.add_argument("--max-batch", type=int, default=0, help="override config max_batch")
+    ap.add_argument("--weights", default="float",
+                    choices=["float", "int8", "int8_fused"],
+                    help="weight precision: int8 = w8a16 (XLA-fused dequant), "
+                         "int8_fused = Pallas fused dequant-matmul for dense")
     ap.add_argument("--transfer-dtype", default=None, choices=["uint8"],
                     help="quantize the host->device wire to uint8 (4x fewer "
                          "bytes than f32 over the link; lossy, opt-in)")
@@ -312,7 +317,8 @@ def main() -> None:
         buckets=cfg["buckets"],
     )
     broker = MemoryBroker(default_partitions=4)
-    run_cfg, topo = build_topology(cfg, broker, batch_cfg, args.transfer_dtype, args.chunk)
+    run_cfg, topo = build_topology(cfg, broker, batch_cfg, args.transfer_dtype, args.chunk,
+                                 args.weights)
     t0 = time.time()
     cluster.submit_topology("bench-throughput", run_cfg, topo)
     log(f"submitted + warmed up in {time.time() - t0:.1f}s")
@@ -348,7 +354,8 @@ def main() -> None:
             buckets=cfg["buckets"],
         )
         broker2 = MemoryBroker(default_partitions=4)
-        run_cfg2, topo2 = build_topology(cfg, broker2, lat_batch_cfg, args.transfer_dtype, args.chunk)
+        run_cfg2, topo2 = build_topology(cfg, broker2, lat_batch_cfg, args.transfer_dtype,
+                                                 args.chunk, args.weights)
         cluster.submit_topology("bench-latency", run_cfg2, topo2)
         # Offer well below saturation: the latency topology uses the short
         # deadline (small batches), so its capacity is below the

@@ -82,9 +82,10 @@ class ModelConfig:
     def __post_init__(self) -> None:
         if self.transfer_dtype not in (None, "uint8"):
             raise ValueError(f"unsupported transfer_dtype {self.transfer_dtype!r}")
-        if self.weights not in ("float", "int8"):
+        if self.weights not in ("float", "int8", "int8_fused"):
             raise ValueError(
-                f"model.weights must be float|int8, got {self.weights!r}")
+                "model.weights must be float|int8|int8_fused, "
+                f"got {self.weights!r}")
 
 
 @dataclass

@@ -55,14 +55,27 @@ def _is_qleaf(x) -> bool:
     return isinstance(x, dict) and "__q" in x
 
 
-def dequantize_params(qparams, dtype):
+def dequantize_params(qparams, dtype, keep_dense: bool = False):
     """Inverse of :func:`quantize_params`; runs INSIDE jit so XLA fuses the
-    int8->dtype multiply into each weight's first use."""
-    return jax.tree.map(
-        lambda l: (l["__q"].astype(dtype) * l["__s"].astype(dtype)
-                   if _is_qleaf(l) else l),
-        qparams, is_leaf=lambda l: _is_qleaf(l),
-    )
+    int8->dtype multiply into each weight's first use.
+
+    ``keep_dense=True`` ("int8_fused" mode) leaves dense-layer weights
+    quantized: :func:`storm_tpu.ops.layers.dense` detects them and runs
+    the Pallas fused dequant-matmul, so they stay int8 all the way to
+    VMEM. "Dense-layer weight" is identified by tree path — a 2-D qleaf
+    under a ``"w"`` key, the `dense_init` layout — NOT by rank alone:
+    other 2-D params (e.g. the MoE gate) are consumed as raw arrays and
+    must be dequantized here. Conv kernels (4-D, also ``"w"``) are
+    dequantized — XLA's conv has no fused-dequant kernel equivalent."""
+    def deq(path, l):
+        if not _is_qleaf(l):
+            return l
+        if keep_dense and l["__q"].ndim == 2 and path and \
+                getattr(path[-1], "key", None) == "w":
+            return l
+        return l["__q"].astype(dtype) * l["__s"].astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(deq, qparams, is_leaf=_is_qleaf)
 
 
 class InferenceEngine:
@@ -98,7 +111,9 @@ class InferenceEngine:
         )
         # BN statistics stay f32 (cast only f32 leaves to compute dtype would
         # nuke them too) — so cast params only; state is small and stays f32.
-        self._w8 = getattr(model_cfg, "weights", "float") == "int8"
+        self._w8 = getattr(model_cfg, "weights", "float") in (
+            "int8", "int8_fused")
+        self._w8_fused = getattr(model_cfg, "weights", "float") == "int8_fused"
         if self._w8:
             # int8 weights + scales live in HBM; dequant happens inside the
             # jit program (fused), so the stored footprint is ~1/2 of bf16.
@@ -120,9 +135,11 @@ class InferenceEngine:
         dtype = self.dtype
         w8 = self._w8
 
+        w8_fused = self._w8_fused
+
         def fwd(params, state, x):
             if w8:
-                params = dequantize_params(params, dtype)
+                params = dequantize_params(params, dtype, keep_dense=w8_fused)
             logits, _ = apply(params, state, x, train=False)
             logits = logits.astype(jnp.float32)
             return jax.nn.softmax(logits, axis=-1) if softmax else logits

@@ -11,20 +11,13 @@ paths are numerically cross-checked in tests/test_ops.py.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 
-def _use_pallas() -> bool:
-    if os.environ.get("STORM_TPU_NO_PALLAS"):
-        return False
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:  # pragma: no cover
-        return False
+from storm_tpu.ops.platform import use_pallas as _use_pallas
 
 
 def attention_reference(

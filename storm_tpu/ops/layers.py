@@ -44,6 +44,12 @@ def dense_init(rng, in_dim: int, out_dim: int, dtype=jnp.float32) -> dict:
 
 
 def dense(p: dict, x: jnp.ndarray) -> jnp.ndarray:
+    if isinstance(p["w"], dict) and "__q" in p["w"]:
+        # Weight left int8 by the engine's "int8_fused" mode: run the
+        # Pallas fused dequant-matmul so only int8 bytes leave HBM.
+        from storm_tpu.ops.quant_matmul import qdense
+
+        return qdense(p, x)
     # Accumulate matmuls in f32 on the MXU even for bf16 inputs.
     return jnp.dot(x, p["w"], preferred_element_type=jnp.float32).astype(x.dtype) + p["b"]
 

@@ -1,0 +1,22 @@
+"""Shared Pallas-vs-reference dispatch predicate for the ops package.
+
+Kernels (flash attention, w8a16 dequant-matmul) run as Pallas on TPU and
+fall back to jnp reference paths elsewhere (CPU tests, unsupported
+shapes). ``STORM_TPU_NO_PALLAS`` forces the reference paths everywhere —
+the escape hatch for debugging numeric diffs.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def use_pallas() -> bool:
+    if os.environ.get("STORM_TPU_NO_PALLAS"):
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False

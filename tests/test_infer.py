@@ -257,3 +257,46 @@ def test_int8_weights_bf16_keeps_compute_dtype():
             assert leaf["__q"].dtype == jnp.int8
         else:
             assert leaf.dtype != jnp.float32, "f32 leaf would promote activations"
+
+
+def test_int8_fused_matches_int8():
+    """"int8_fused" (Pallas fused dequant-matmul on TPU; jnp fallback here)
+    quantizes identically to "int8" — outputs must agree tightly on a
+    dense-only model (mixer: every matmul goes through layers.dense)."""
+    import numpy as np
+
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.infer.engine import InferenceEngine
+
+    x = np.random.RandomState(1).rand(4, 32, 32, 3).astype(np.float32)
+    outs = {}
+    for weights in ("int8", "int8_fused"):
+        eng = InferenceEngine(
+            ModelConfig(name="mixer_tiny", input_shape=(32, 32, 3),
+                        dtype="float32", weights=weights),
+            ShardingConfig(data_parallel=0),
+            BatchConfig(max_batch=4, buckets=(4,)),
+        )
+        outs[weights] = eng.predict(x)
+    np.testing.assert_allclose(outs["int8"], outs["int8_fused"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_int8_fused_moe_model_runs():
+    """Regression: the keep-dense predicate must be path-based — MoE params
+    (2-D gate/biases consumed as raw arrays, not via layers.dense) crashed
+    the rank-based version."""
+    import numpy as np
+
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.infer.engine import InferenceEngine
+
+    x = np.random.RandomState(2).rand(4, 32, 32, 3).astype(np.float32)
+    eng = InferenceEngine(
+        ModelConfig(name="moe_vit_tiny", input_shape=(32, 32, 3),
+                    dtype="float32", weights="int8_fused"),
+        ShardingConfig(data_parallel=0),
+        BatchConfig(max_batch=4, buckets=(4,)),
+    )
+    out = eng.predict(x)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-4)

@@ -80,3 +80,41 @@ def test_flash_attention_matches_reference_interpret():
         want = attention_reference(q, k, v)
         got = flash_attention(q, k, v, interpret=True, block_k=256)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+
+
+def test_w8a16_matmul_matches_dequant_reference():
+    """Pallas fused dequant-matmul (interpreter on CPU) vs explicit
+    dequantize-then-dot, over shapes that exercise M/N/K padding and
+    3-D activations (the ViT token layout)."""
+    from storm_tpu.infer.engine import quantize_params
+    from storm_tpu.ops.quant_matmul import w8a16_matmul
+
+    rng = np.random.RandomState(0)
+    for xshape, k, n in [
+        ((4, 64), 64, 128),        # exact tiles
+        ((5, 100), 100, 70),       # every axis padded
+        ((2, 9, 48), 48, 200),     # 3-D activations, N > block_n
+        ((1, 700), 700, 10),       # K > block_k (multi-chunk loop)
+    ]:
+        x = jnp.asarray(rng.randn(*xshape), jnp.float32)
+        w = jnp.asarray(rng.randn(k, n), jnp.float32)
+        q = quantize_params({"w": w})["w"]
+        want = x @ (q["__q"].astype(jnp.float32) * q["__s"])
+        got = w8a16_matmul(x, q["__q"], q["__s"], interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-4)
+
+
+def test_dense_dispatches_on_quantized_weights():
+    """layers.dense must route {"__q","__s"} weights through the fused
+    path (jnp fallback off-TPU) and match the float layer closely."""
+    from storm_tpu.infer.engine import quantize_params
+    from storm_tpu.ops import layers as L
+
+    rng = jax.random.PRNGKey(3)
+    p = L.dense_init(rng, 32, 16)
+    x = jax.random.normal(jax.random.PRNGKey(4), (6, 32), jnp.float32)
+    want = L.dense(p, x)
+    qp = {"w": quantize_params({"w": p["w"]})["w"], "b": p["b"]}
+    got = L.dense(qp, x)
+    assert np.max(np.abs(np.asarray(got - want))) < 0.05
