@@ -280,6 +280,12 @@ def main() -> None:
     ap.add_argument("--latency-seconds", type=float, default=8.0)
     ap.add_argument("--max-wait-ms", type=float, default=25.0)
     ap.add_argument("--max-batch", type=int, default=0, help="override config max_batch")
+    ap.add_argument("--buckets", default="",
+                    help="comma-separated padding buckets override, e.g. 64,1024")
+    ap.add_argument("--inflight", type=int, default=0,
+                    help="batches in flight per operator (BatchConfig."
+                         "max_inflight); 0 = auto (4 for the throughput "
+                         "phase to amortize launch RTT, 2 for latency)")
     ap.add_argument("--weights", default="float",
                     choices=["float", "int8", "int8_fused"],
                     help="weight precision: int8 = w8a16 (XLA-fused dequant), "
@@ -311,10 +317,13 @@ def main() -> None:
     cluster = LocalCluster()
 
     # ---- throughput phase: long deadline -> full MXU-sized batches -----------
+    buckets = tuple(int(b) for b in args.buckets.split(",")) if args.buckets \
+        else cfg["buckets"]
     batch_cfg = BatchConfig(
         max_batch=args.max_batch or cfg["max_batch"],
         max_wait_ms=max(args.max_wait_ms, 100.0),
-        buckets=cfg["buckets"],
+        buckets=buckets,
+        max_inflight=args.inflight or 4,
     )
     broker = MemoryBroker(default_partitions=4)
     run_cfg, topo = build_topology(cfg, broker, batch_cfg, args.transfer_dtype, args.chunk,
@@ -351,7 +360,8 @@ def main() -> None:
         lat_batch_cfg = BatchConfig(
             max_batch=args.max_batch or cfg["max_batch"],
             max_wait_ms=args.max_wait_ms,
-            buckets=cfg["buckets"],
+            buckets=buckets,
+            max_inflight=args.inflight or 2,
         )
         broker2 = MemoryBroker(default_partitions=4)
         run_cfg2, topo2 = build_topology(cfg, broker2, lat_batch_cfg, args.transfer_dtype,

@@ -31,6 +31,11 @@ class BatchConfig:
     # Padding buckets (ascending). Batches are padded to the smallest bucket
     # >= their size; the final entry must equal max_batch.
     buckets: tuple = (8, 32, 128, 256)
+    # Batches allowed in flight per operator instance: one computing on
+    # device while the next accumulates/pads. Deeper pipelining amortizes
+    # high per-launch dispatch latency (remote/tunneled devices) at the
+    # cost of tail latency.
+    max_inflight: int = 2
 
     def __post_init__(self) -> None:
         self.buckets = tuple(sorted(set(int(b) for b in self.buckets)))

@@ -105,9 +105,8 @@ class InferenceBolt(Bolt):
         self.batcher = MicroBatcher(self.batch_cfg)
         self._flush_task: Optional[asyncio.Task] = None
         self._inflight: Set[asyncio.Task] = set()
-        # At most 2 batches in flight: one computing on device while the
-        # next accumulates/pads — more just adds latency, not throughput.
-        self._dispatch_sem = asyncio.Semaphore(2)
+        self._dispatch_sem = asyncio.Semaphore(
+            max(1, getattr(self.batch_cfg, "max_inflight", 2)))
         m = context.metrics
         cid = context.component_id
         self._m_batch = m.histogram(cid, "batch_size")

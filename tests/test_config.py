@@ -85,3 +85,10 @@ def test_model_config_validates_weights():
     assert ModelConfig(weights="int8").weights == "int8"
     with pytest.raises(ValueError, match="weights"):
         ModelConfig(weights="int4")
+
+
+def test_batch_config_max_inflight():
+    from storm_tpu.config import BatchConfig
+
+    assert BatchConfig().max_inflight == 2
+    assert BatchConfig(max_inflight=4).max_inflight == 4
