@@ -317,8 +317,14 @@ def main() -> None:
     cluster = LocalCluster()
 
     # ---- throughput phase: long deadline -> full MXU-sized batches -----------
-    buckets = tuple(int(b) for b in args.buckets.split(",")) if args.buckets \
-        else cfg["buckets"]
+    if args.buckets:
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+        top = args.max_batch or cfg["max_batch"]
+        if max(buckets) > top:
+            sys.exit(f"--buckets max {max(buckets)} exceeds max_batch {top}; "
+                     f"pass --max-batch {max(buckets)}")
+    else:
+        buckets = cfg["buckets"]
     batch_cfg = BatchConfig(
         max_batch=args.max_batch or cfg["max_batch"],
         max_wait_ms=max(args.max_wait_ms, 100.0),

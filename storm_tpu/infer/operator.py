@@ -106,7 +106,7 @@ class InferenceBolt(Bolt):
         self._flush_task: Optional[asyncio.Task] = None
         self._inflight: Set[asyncio.Task] = set()
         self._dispatch_sem = asyncio.Semaphore(
-            max(1, getattr(self.batch_cfg, "max_inflight", 2)))
+            max(1, self.batch_cfg.max_inflight))
         m = context.metrics
         cid = context.component_id
         self._m_batch = m.histogram(cid, "batch_size")

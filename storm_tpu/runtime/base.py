@@ -16,6 +16,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
+from storm_tpu.runtime.groupings import DirectGrouping
 from storm_tpu.runtime.tuples import Tuple, Values, new_id
 
 
@@ -70,6 +71,7 @@ class OutputCollector:
         anchors: Optional[Iterable[Tuple]] = None,
         msg_id: Any = None,
         root_ts: Optional[float] = None,
+        direct_task: Optional[int] = None,
     ) -> int:
         """Emit a tuple downstream. Returns the number of deliveries.
 
@@ -77,6 +79,9 @@ class OutputCollector:
         Spout usage: ``await collector.emit(Values(x), msg_id=offset)`` —
         a non-None ``msg_id`` opens an at-least-once ledger entry whose
         completion/failure is reported back to the spout.
+
+        ``direct_task`` (normally via :meth:`emit_direct`) delivers only to
+        subscriptions using ``DirectGrouping``, at that instance index.
         """
         fields = self._out_fields.get(stream, ("message",))
         subs = self._rt.router.subscriptions(self.component_id, stream)
@@ -102,8 +107,19 @@ class OutputCollector:
 
         deliveries: List[Any] = []  # (inbox, )
         for grouping, group in subs:
-            for idx in grouping.choose(probe):
-                deliveries.append(group.inboxes[idx])
+            if direct_task is not None:
+                # emit_direct: only direct-grouped consumers, at the named
+                # instance (Storm's emitDirect/directGrouping contract —
+                # an out-of-range task is a producer bug, not a wrap).
+                if isinstance(grouping, DirectGrouping):
+                    if not 0 <= direct_task < len(group.inboxes):
+                        raise ValueError(
+                            f"emit_direct task {direct_task} out of range "
+                            f"for {len(group.inboxes)}-instance consumer")
+                    deliveries.append(group.inboxes[direct_task])
+            else:
+                for idx in grouping.choose(probe):
+                    deliveries.append(group.inboxes[idx])
 
         root_id = None
         if msg_id is not None:
@@ -145,6 +161,24 @@ class OutputCollector:
             n += 1
         self._m_emitted.inc(n)
         return n
+
+    async def emit_direct(
+        self,
+        task: int,
+        values: Sequence[Any],
+        *,
+        stream: str = "default",
+        anchors: Optional[Iterable[Tuple]] = None,
+        msg_id: Any = None,
+        root_ts: Optional[float] = None,
+    ) -> int:
+        """Emit to instance ``task`` of every direct-grouped subscriber
+        (Storm's ``emitDirect``; consumers subscribe with
+        ``direct_grouping``)."""
+        return await self.emit(
+            values, stream=stream, anchors=anchors, msg_id=msg_id,
+            root_ts=root_ts, direct_task=task,
+        )
 
     # ---- acking --------------------------------------------------------------
 

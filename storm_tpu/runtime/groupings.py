@@ -67,8 +67,14 @@ class GlobalGrouping(Grouping):
         return (0,)
 
 
+class NoneGrouping(ShuffleGrouping):
+    """Storm's "none" grouping: "don't care" routing. Currently equivalent
+    to shuffle, as in Storm itself."""
+
+
 class DirectGrouping(Grouping):
-    """Producer names the target instance via ``emit_direct``."""
+    """Producer names the target instance via
+    ``collector.emit_direct(task, ...)``."""
 
     def choose(self, t: Tuple) -> Sequence[int]:  # pragma: no cover
         raise RuntimeError("direct grouping requires emit_direct(task, ...)")

@@ -70,6 +70,19 @@ class _Declarer:
     def global_grouping(self, source: str, stream: str = "default") -> "_Declarer":
         return self.grouping(source, G.GlobalGrouping(), stream)
 
+    def none_grouping(self, source: str, stream: str = "default") -> "_Declarer":
+        return self.grouping(source, G.NoneGrouping(), stream)
+
+    def direct_grouping(self, source: str, stream: str = "default") -> "_Declarer":
+        """Subscribe for ``collector.emit_direct(task, ...)`` deliveries."""
+        return self.grouping(source, G.DirectGrouping(), stream)
+
+    def custom_grouping(
+        self, source: str, grouping: G.Grouping, stream: str = "default"
+    ) -> "_Declarer":
+        """Storm's ``customGrouping``: any user Grouping subclass."""
+        return self.grouping(source, grouping, stream)
+
     def set_memory_load(self, mb: float) -> "_Declarer":
         """Per-task memory hint (Storm's ``setMemoryLoad``) for
         resource-aware placement."""

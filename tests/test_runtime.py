@@ -364,3 +364,62 @@ def test_sync_localcluster_facade():
         assert snap["s"]["emitted"] == 2
         cluster.kill_topology("t")
     assert sorted(m for _, m in CaptureBolt.seen) == ["1", "2"]
+
+
+def test_direct_grouping_emit_direct(run):
+    """emit_direct(task, ...) reaches exactly the named instance of
+    direct-grouped consumers (Storm's emitDirect contract); non-direct
+    subscribers on the stream see nothing from direct emits."""
+    CaptureBolt.seen = None
+
+    class RouteBolt(Bolt):
+        async def execute(self, t):
+            # Route message "m<i>" to task i % 3 explicitly.
+            i = int(t.values[0][1:])
+            await self.collector.emit_direct(i % 3, Values(t.values),
+                                             anchors=[t])
+            self.collector.ack(t)
+
+    async def go():
+        cluster = AsyncLocalCluster()
+        b = TopologyBuilder()
+        spout = ListSpout([f"m{i}" for i in range(12)])
+        b.set_spout("s", spout, 1)
+        b.set_bolt("r", RouteBolt(), 1).shuffle_grouping("s")
+        b.set_bolt("c", CaptureBolt(), 3).direct_grouping("r")
+        rt = await cluster.submit("t", Config(), b.build())
+        assert await settle(rt, "s", 12)
+        await cluster.shutdown()
+
+    run(go())
+    assert len(CaptureBolt.seen) == 12
+    for task, msg in CaptureBolt.seen:
+        assert task == int(msg[1:]) % 3, (task, msg)
+
+
+def test_none_and_custom_grouping(run):
+    """none_grouping delivers everything; custom_grouping (a user Grouping
+    subclass) steers tuples with its own choose()."""
+    from storm_tpu.runtime import groupings as G
+
+    CaptureBolt.seen = None
+
+    class LastCharGrouping(G.Grouping):
+        def choose(self, t):
+            return (int(t.values[0][-1]) % self.n,)
+
+    async def go():
+        cluster = AsyncLocalCluster()
+        b = TopologyBuilder()
+        spout = ListSpout([f"m{i}" for i in range(10)])
+        b.set_spout("s", spout, 1)
+        b.set_bolt("p", PassBolt(), 2).none_grouping("s")
+        b.set_bolt("c", CaptureBolt(), 2).custom_grouping("p", LastCharGrouping())
+        rt = await cluster.submit("t", Config(), b.build())
+        assert await settle(rt, "s", 10)
+        await cluster.shutdown()
+
+    run(go())
+    assert len(CaptureBolt.seen) == 10
+    for task, msg in CaptureBolt.seen:
+        assert task == int(msg[-1]) % 2, (task, msg)
