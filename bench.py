@@ -319,6 +319,8 @@ def main() -> None:
     # ---- throughput phase: long deadline -> full MXU-sized batches -----------
     if args.buckets:
         buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+        if not buckets:
+            sys.exit(f"--buckets {args.buckets!r} contains no bucket sizes")
         top = args.max_batch or cfg["max_batch"]
         if max(buckets) > top:
             sys.exit(f"--buckets max {max(buckets)} exceeds max_batch {top}; "

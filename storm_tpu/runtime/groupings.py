@@ -8,9 +8,28 @@ reference's shape can be expressed (fields/all/global/direct/local-or-shuffle).
 from __future__ import annotations
 
 import random
+import zlib
 from typing import List, Sequence
 
 from storm_tpu.runtime.tuples import Tuple
+
+
+def stable_hash(key: object) -> int:
+    """Process-stable, value-based key hash. Python's ``hash()`` is salted
+    per process, which would route the same key differently from different
+    producer workers in dist mode. Primitives and containers of them are
+    encoded canonically; anything else falls back to ``hash()`` (value-
+    based iff the type defines ``__hash__`` — such keys keep single-
+    process affinity only, same as before)."""
+    return zlib.crc32(_canonical(key))
+
+
+def _canonical(v: object) -> bytes:
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return f"{type(v).__name__}:{v!r};".encode("utf-8", "surrogatepass")
+    if isinstance(v, (tuple, list)):
+        return b"seq:" + b"".join(_canonical(x) for x in v) + b";"
+    return f"obj:{hash(v)};".encode()
 
 
 class Grouping:
@@ -50,7 +69,7 @@ class FieldsGrouping(Grouping):
 
     def choose(self, t: Tuple) -> Sequence[int]:
         key = tuple(t.get(f) for f in self.field_names)
-        return (hash(key) % self.n,)
+        return (stable_hash(key) % self.n,)
 
 
 class AllGrouping(Grouping):
@@ -65,6 +84,31 @@ class GlobalGrouping(Grouping):
 
     def choose(self, t: Tuple) -> Sequence[int]:
         return (0,)
+
+
+class PartialKeyGrouping(Grouping):
+    """Storm's ``partialKeyGrouping`` (Nasir et al., "power of two
+    choices"): each key hashes to two candidate instances and the less
+    loaded one is chosen — key affinity is relaxed to 2 owners in exchange
+    for balance under key skew. Aggregations downstream must merge the
+    two partials (exactly Storm's contract)."""
+
+    def __init__(self, *field_names: str) -> None:
+        self.fields = field_names
+
+    def prepare(self, n: int) -> None:
+        super().prepare(n)
+        self._load = [0] * n
+
+    def choose(self, t: Tuple) -> Sequence[int]:
+        key = tuple(t.get(f) for f in self.fields) if self.fields \
+            else tuple(t.values)
+        h = stable_hash(key)
+        a = h % self.n
+        b = (h >> 17) % self.n
+        pick = a if self._load[a] <= self._load[b] else b
+        self._load[pick] += 1
+        return (pick,)
 
 
 class NoneGrouping(ShuffleGrouping):

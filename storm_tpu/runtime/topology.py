@@ -73,6 +73,11 @@ class _Declarer:
     def none_grouping(self, source: str, stream: str = "default") -> "_Declarer":
         return self.grouping(source, G.NoneGrouping(), stream)
 
+    def partial_key_grouping(
+        self, source: str, *fields: str, stream: str = "default"
+    ) -> "_Declarer":
+        return self.grouping(source, G.PartialKeyGrouping(*fields), stream)
+
     def direct_grouping(self, source: str, stream: str = "default") -> "_Declarer":
         """Subscribe for ``collector.emit_direct(task, ...)`` deliveries."""
         return self.grouping(source, G.DirectGrouping(), stream)

@@ -423,3 +423,51 @@ def test_none_and_custom_grouping(run):
     assert len(CaptureBolt.seen) == 10
     for task, msg in CaptureBolt.seen:
         assert task == int(msg[-1]) % 2, (task, msg)
+
+
+def test_partial_key_grouping_two_choices(run):
+    """Every key lands on at most 2 instances (power-of-two-choices), and a
+    heavily skewed key stream still spreads across instances — the balance
+    FieldsGrouping can't give under skew."""
+    CaptureBolt.seen = None
+
+    class KeySpout(ListSpout):
+        pass
+
+    async def go():
+        cluster = AsyncLocalCluster()
+        b = TopologyBuilder()
+        # 90% one hot key + a tail of others.
+        items = ["hot"] * 36 + [f"k{i}" for i in range(4)]
+        b.set_spout("s", KeySpout(items), 1)
+        b.set_bolt("c", CaptureBolt(), 4).partial_key_grouping("s", "message")
+        rt = await cluster.submit("t", Config(), b.build())
+        assert await settle(rt, "s", 40)
+        await cluster.shutdown()
+
+    run(go())
+    owners = {}
+    for task, msg in CaptureBolt.seen:
+        owners.setdefault(msg, set()).add(task)
+    assert all(len(v) <= 2 for v in owners.values()), owners
+    hot = owners["hot"]
+    assert len(hot) == 2  # the skewed key used both its candidates
+
+
+def test_stable_hash_groupings_cross_process_consistent():
+    """FieldsGrouping/PartialKeyGrouping routing must not depend on the
+    producer process's hash salt (dist mode: many producer workers)."""
+    import os, pathlib, subprocess, sys
+
+    root = str(pathlib.Path(__file__).resolve().parents[1])
+    code = ("from storm_tpu.runtime.groupings import stable_hash;"
+            "print(stable_hash(('user-42', 7)))")
+    outs = {
+        subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True,
+                       env={**os.environ, "PYTHONPATH": root,
+                            "PYTHONHASHSEED": str(seed)},
+                       cwd=root).stdout.strip()
+        for seed in (1, 2)
+    }
+    assert len(outs) == 1 and outs != {""}, outs
