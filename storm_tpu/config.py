@@ -83,6 +83,12 @@ class ModelConfig:
     # bytes than f32 over the PCIe/tunnel link, which is the streaming
     # bottleneck (BENCH_NOTES.md). Lossy (8-bit) and therefore opt-in.
     transfer_dtype: Optional[str] = None
+    # Persistent XLA compilation-cache directory. A restarted daemon
+    # reloads compiled executables from disk instead of re-tracing and
+    # re-compiling every bucket shape (the reference pays model load on
+    # every worker start, InferenceBolt.java:44-62; here recompiles are
+    # the analogous cold-start cost). "" disables.
+    compile_cache_dir: str = ""
 
     def __post_init__(self) -> None:
         if self.transfer_dtype not in (None, "uint8"):

@@ -14,6 +14,7 @@ Outputs are softmax probabilities, matching the reference's fetch of
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, Optional, Tuple
 
@@ -25,6 +26,8 @@ from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
 from storm_tpu.models.registry import ModelDef, build_model, load_or_init
 from storm_tpu.parallel.mesh import make_mesh
 from storm_tpu.parallel.sharding import batch_sharding, replicated
+
+logger = logging.getLogger(__name__)
 
 
 # ---- weight-only int8 quantization (w8a16 serving) ----------------------------
@@ -78,6 +81,32 @@ def dequantize_params(qparams, dtype, keep_dense: bool = False):
     return jax.tree_util.tree_map_with_path(deq, qparams, is_leaf=_is_qleaf)
 
 
+_COMPILE_CACHE_DIR: Optional[str] = None
+
+
+def enable_compile_cache(cache_dir: str, min_compile_secs: float = 0.1) -> None:
+    """Turn on jax's persistent executable cache (process-global, applied
+    once — jax latches the directory at first compile). Restarted daemons
+    then reload compiled bucket shapes instead of re-tracing. Also lowers
+    the min-compile-time persistence gate from jax's 1.0s default so the
+    small models in the zoo are cached too. Called from engine init when
+    ``ModelConfig.compile_cache_dir`` is set; callable directly at daemon
+    startup."""
+    global _COMPILE_CACHE_DIR
+    if _COMPILE_CACHE_DIR is not None:
+        if _COMPILE_CACHE_DIR != cache_dir:
+            logger.warning(
+                "compile cache already latched at %s; ignoring %s "
+                "(jax supports one cache dir per process)",
+                _COMPILE_CACHE_DIR, cache_dir,
+            )
+        return
+    _COMPILE_CACHE_DIR = cache_dir
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_secs)
+
+
 class InferenceEngine:
     def __init__(
         self,
@@ -90,6 +119,8 @@ class InferenceEngine:
         self.model_cfg = model_cfg
         self.sharding_cfg = sharding_cfg or ShardingConfig()
         self.batch_cfg = batch_cfg or BatchConfig()
+        if getattr(model_cfg, "compile_cache_dir", ""):
+            enable_compile_cache(model_cfg.compile_cache_dir)
         self.model: ModelDef = build_model(
             model_cfg.name,
             num_classes=model_cfg.num_classes,
@@ -271,6 +302,7 @@ def shared_engine(
         model_cfg.checkpoint,
         model_cfg.seed,
         getattr(model_cfg, "weights", "float"),
+        getattr(model_cfg, "compile_cache_dir", ""),
         # builder kwargs are part of the model identity (width=0.5 vs 1.0
         # must not share one cached engine); deep-freeze so TOML-sourced
         # list values stay hashable

@@ -300,3 +300,38 @@ def test_int8_fused_moe_model_runs():
     )
     out = eng.predict(x)
     np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_compile_cache_dir_populated(tmp_path):
+    """compile_cache_dir wires up jax's persistent compilation cache: a
+    fresh engine writes executables there on warmup."""
+    import jax
+    import numpy as np
+
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.infer.engine import InferenceEngine
+
+    from jax._src import compilation_cache
+
+    from storm_tpu.infer import engine as eng_mod
+
+    cache = tmp_path / "xla-cache"
+    prior_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        eng = InferenceEngine(
+            ModelConfig(name="lenet5", input_shape=(28, 28, 1),
+                        dtype="float32", compile_cache_dir=str(cache)),
+            ShardingConfig(data_parallel=0),
+            BatchConfig(max_batch=4, buckets=(4,)),
+        )
+        eng.predict(np.zeros((4, 28, 28, 1), np.float32))
+        assert cache.exists() and any(cache.iterdir())
+    finally:
+        # Un-latch both jax's cache object and the engine's once-guard so
+        # later tests neither read a deleted tmp dir nor skip their own dir.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prior_min)
+        jax.config.update("jax_compilation_cache_dir", None)
+        compilation_cache.reset_cache()
+        eng_mod._COMPILE_CACHE_DIR = None
