@@ -57,6 +57,7 @@ class DistCluster:
         self._monitor_stop = threading.Event()
         self._recipe: Optional[dict] = None
         self._rebalances: Dict[str, int] = {}
+        self._swaps: Dict[str, dict] = {}
         self._activated = True
         self._closing = False
         if addrs:
@@ -125,6 +126,7 @@ class DistCluster:
             }
             self._activated = True  # fresh topology starts active
             self._rebalances.clear()
+            self._swaps.clear()
             for c in self.clients:
                 c.control(
                     "submit",
@@ -326,6 +328,29 @@ class DistCluster:
             # tasks the replacement doesn't have).
             self._rebalances[component] = parallelism
 
+    def swap_model(self, component: str, overrides: dict,
+                   timeout: float = 600.0) -> dict:
+        """Live model swap on the worker hosting ``component`` (components
+        are placed whole, so exactly one worker owns its executors).
+
+        The RPC runs OUTSIDE the controller lock: engine build+warmup can
+        take minutes and must not stall heartbeats/recovery. The swap is
+        recorded (like rebalances) so a recovered replacement worker
+        rebuilds on the swapped model, not the submit-time one."""
+        with self._lock:
+            w = self._placement.get(component)
+            if w is None:
+                raise KeyError(component)
+            client = self.clients[w]
+        resp = client.control(
+            "swap_model", component=component, model=overrides,
+            timeout=timeout,
+        )
+        with self._lock:
+            merged = {**self._swaps.get(component, {}), **overrides}
+            self._swaps[component] = merged
+        return resp.get("model", {})
+
     # ---- failure detection + elastic recovery (SURVEY.md §5.3) ---------------
 
     def start_monitor(
@@ -470,6 +495,14 @@ class DistCluster:
                     client.control(
                         "rebalance", component=component, parallelism=par
                     )
+                # Re-apply live model swaps, or the replacement serves the
+                # submit-time model (silent rollout rollback).
+                for component, overrides in self._swaps.items():
+                    if self._placement.get(component) == idx:
+                        client.control(
+                            "swap_model", component=component,
+                            model=overrides, timeout=600.0,
+                        )
 
     # ---- teardown ------------------------------------------------------------
 
@@ -506,6 +539,7 @@ class DistCluster:
         with self._lock:
             self._recipe = None  # a recovery after kill must not resurrect it
             self._rebalances.clear()
+            self._swaps.clear()
             for c in self.clients:
                 c.control("kill", wait_secs=wait_secs)
 

@@ -150,6 +150,10 @@ class DistRuntimeView:
     async def rebalance(self, component: str, parallelism: int) -> None:
         await asyncio.to_thread(self._dist.rebalance, component, parallelism)
 
+    async def swap_model(self, component: str, overrides: dict) -> dict:
+        return await asyncio.to_thread(
+            self._dist.swap_model, component, overrides)
+
     async def worker_logs(self, index: int, tail_bytes: int = 16384) -> str:
         return await asyncio.to_thread(self._dist.worker_logs, index, tail_bytes)
 

@@ -465,6 +465,16 @@ class WorkerServer:
             else:
                 self._run_on_loop(self.rt.resize_remote_group(component, new))
             return {"ok": True, "previous": prev}
+        if cmd == "swap_model":
+            import dataclasses as _dc
+
+            # Engine build+warmup can far exceed the default control
+            # timeout; match the controller's 600s budget.
+            new_cfg = self._run_on_loop(
+                self.rt.swap_model(req["component"], req["model"]),
+                timeout=600.0,
+            )
+            return {"ok": True, "model": _dc.asdict(new_cfg)}
         if cmd == "update_peer":
             self._run_on_loop(
                 self.rt.replace_peer(int(req["idx"]), req["addr"])

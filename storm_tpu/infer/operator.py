@@ -242,6 +242,29 @@ class InferenceBolt(Bolt):
         finally:
             self._dispatch_sem.release()
 
+    async def swap_model(self, model_cfg: ModelConfig) -> None:
+        """Zero-downtime model swap (the reference ships its model inside
+        the application jar, InferenceBolt.java:49-57 — redeploying means a
+        full topology restart; here a new checkpoint/model goes live under
+        traffic). The new engine is built and warmed on a worker thread,
+        then the reference is switched atomically: batches already in
+        flight finish on the old engine, later batches use the new one.
+        The old engine stays in the process cache for instant rollback
+        (swap back) at the cost of its HBM footprint.
+
+        Swapping to a different ``input_shape`` may fail-and-replay tuples
+        decoded under the old shape that are still in the batcher —
+        at-least-once delivery covers them."""
+
+        def build() -> InferenceEngine:
+            eng = shared_engine(model_cfg, self.sharding_cfg, self.batch_cfg)
+            eng.warmup()
+            return eng
+
+        new_engine = await asyncio.to_thread(build)
+        self.engine = new_engine
+        self.model_cfg = model_cfg
+
     async def tick(self) -> None:
         batch = self.batcher.take_if_due()
         if batch is not None:

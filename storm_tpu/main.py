@@ -256,6 +256,21 @@ def _ctl(args) -> int:
         rc, out = call("POST", f"/api/v1/topology/{topo}/rebalance",
                        {"component": args.component,
                         "parallelism": args.parallelism})
+    elif cmd == "swap-model":
+        overrides = {}
+        for kv in args.set:
+            if "=" not in kv:
+                print(f"--set needs key=value, got {kv!r}", file=sys.stderr)
+                return 2
+            k, v = kv.split("=", 1)
+            try:
+                overrides[k] = json.loads(v)  # numbers/bools/lists/null
+            except ValueError:
+                overrides[k] = v  # bare string (checkpoint paths etc.)
+        # Engine warmup happens inside this call; give it compile time.
+        rc, out = call("POST", f"/api/v1/topology/{topo}/swap_model",
+                       {"component": args.component, "model": overrides},
+                       timeout=600)
     elif cmd == "logs":
         rc, out = call(
             "GET",
@@ -359,6 +374,15 @@ def main(argv=None) -> int:
     c.add_argument("topology")
     c.add_argument("component")
     c.add_argument("parallelism", type=int)
+    c = ctlsub.add_parser(
+        "swap-model",
+        help="live model swap: apply ModelConfig field overrides to a "
+             "running inference component (zero-downtime rollout/rollback)")
+    c.add_argument("topology")
+    c.add_argument("component")
+    c.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="ModelConfig field override, repeatable "
+                        "(e.g. --set checkpoint=/models/v2)")
     c = ctlsub.add_parser("logs")
     c.add_argument("topology")
     c.add_argument("--worker", type=int, default=0)

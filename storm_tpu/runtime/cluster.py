@@ -347,6 +347,40 @@ class TopologyRuntime:
 
     # ---- elasticity ----------------------------------------------------------
 
+    async def swap_model(self, component_id: str, overrides: dict):
+        """Live model swap on an inference component: apply field
+        ``overrides`` (e.g. ``{"checkpoint": "/models/v2"}``) to its
+        current ModelConfig and roll every instance onto the new engine
+        under traffic. Returns the new config."""
+        import dataclasses as _dc
+
+        execs = self.bolt_execs.get(component_id)
+        if execs is None:
+            raise KeyError(component_id)
+        swappable = [e for e in execs if hasattr(e.bolt, "swap_model")]
+        if not swappable:
+            raise TypeError(f"component {component_id!r} has no model to swap")
+        new_cfg = _dc.replace(swappable[0].bolt.model_cfg, **overrides)
+        # Update the prototype FIRST: executors cloned by a rebalance that
+        # interleaves with the (slow, awaiting) engine builds below must
+        # pick up the new model, not the submit-time one.
+        proto = self.topology.specs[component_id].obj
+        if hasattr(proto, "model_cfg"):
+            proto.model_cfg = new_cfg
+        # First call builds+warms the engine (shared per process); the rest
+        # just switch references. Re-scan until stable: a rebalance during
+        # an await may have added instances cloned before the proto update.
+        while True:
+            pending = [
+                e for e in self.bolt_execs.get(component_id, ())
+                if hasattr(e.bolt, "swap_model")
+                and e.bolt.model_cfg is not new_cfg
+            ]
+            if not pending:
+                return new_cfg
+            for e in pending:
+                await e.bolt.swap_model(new_cfg)
+
     async def rebalance(self, component_id: str, parallelism: int) -> None:
         """Change a component's parallelism live — the framework op the
         reference's README frames as 'rebuild with more bolts'

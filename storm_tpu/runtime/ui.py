@@ -396,6 +396,24 @@ class UIServer:
             await rt.deactivate()
             ok = await rt.drain(timeout_s=timeout_s)
             return 200, {"status": "INACTIVE", "drained": bool(ok)}
+        if action == "swap_model":
+            component = args.get("component")
+            overrides = args.get("model")
+            if not component or not isinstance(overrides, dict) or not overrides:
+                return 400, {"error": "need component and a non-empty "
+                                      "model overrides object"}
+            try:
+                new_cfg = await rt.swap_model(component, overrides)
+            except KeyError:
+                return 404, {"error": f"no component {component!r}"}
+            except TypeError as e:
+                return 400, {"error": str(e)}
+            except ValueError as e:
+                return 400, {"error": f"invalid model config: {e}"}
+            import dataclasses as _dc
+
+            model = _dc.asdict(new_cfg) if _dc.is_dataclass(new_cfg) else new_cfg
+            return 200, {"component": component, "model": model}
         if action == "rebalance":
             component = args.get("component")
             try:

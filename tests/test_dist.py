@@ -280,3 +280,55 @@ def test_dist_worker_failure_recovery():
             cluster.kill()
     finally:
         stub.close()
+
+
+@pytest.mark.slow
+def test_dist_live_model_swap():
+    """Controller routes swap_model to the hosting worker; traffic keeps
+    flowing on the new model config."""
+    stub = KafkaStubBroker(partitions=1)
+    try:
+        cfg = Config()
+        cfg.broker.kind = "kafka"
+        cfg.broker.bootstrap = f"127.0.0.1:{stub.port}"
+        cfg.broker.input_topic = "sw-in"
+        cfg.broker.output_topic = "sw-out"
+        cfg.model.name = "lenet5"
+        cfg.model.dtype = "float32"
+        cfg.offsets.policy = "earliest"
+        cfg.offsets.max_behind = None
+        cfg.batch.max_batch = 4
+        cfg.batch.buckets = (4,)
+        cfg.topology.spout_parallelism = 1
+        cfg.topology.inference_parallelism = 1
+        cfg.topology.sink_parallelism = 1
+
+        with DistCluster(1, env={"JAX_PLATFORMS": "cpu", "STORM_TPU_PLATFORM": "cpu"}) as cluster:
+            cluster.submit("dist-swap", cfg)
+
+            from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+            producer = KafkaWireBroker(cfg.broker.bootstrap)
+            rng = np.random.RandomState(1)
+
+            def feed(n):
+                start = stub.topic_size("sw-out")
+                for _ in range(n):
+                    x = rng.rand(1, 28, 28, 1).astype(np.float32)
+                    producer.produce(
+                        "sw-in", json.dumps({"instances": x.tolist()}))
+                deadline = time.time() + 60
+                while (time.time() < deadline
+                       and stub.topic_size("sw-out") < start + n):
+                    time.sleep(0.1)
+                assert stub.topic_size("sw-out") == start + n
+
+            feed(3)
+            new_model = cluster.swap_model("inference-bolt", {"seed": 99})
+            assert new_model["seed"] == 99
+            feed(3)
+            with pytest.raises(KeyError):
+                cluster.swap_model("no-such-bolt", {"seed": 1})
+            cluster.kill()
+    finally:
+        stub.close()

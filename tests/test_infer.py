@@ -335,3 +335,69 @@ def test_compile_cache_dir_populated(tmp_path):
         jax.config.update("jax_compilation_cache_dir", None)
         compilation_cache.reset_cache()
         eng_mod._COMPILE_CACHE_DIR = None
+
+
+def test_live_model_swap_under_traffic(run):
+    """swap_model rolls a running inference component onto a new engine
+    with zero downtime: traffic before, during, and after all acks; the
+    new config is live; predictions change (different seed => different
+    random-init weights)."""
+    import asyncio
+    import json as _json
+
+    import numpy as np
+
+    from storm_tpu.config import BatchConfig, Config, ModelConfig
+    from storm_tpu.connectors import BrokerSink, BrokerSpout, MemoryBroker
+    from storm_tpu.infer import InferenceBolt
+    from storm_tpu.runtime import TopologyBuilder
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    async def go():
+        broker = MemoryBroker()
+        cfg = Config()
+        tb = TopologyBuilder()
+        tb.set_spout("spout", BrokerSpout(broker, "in"), parallelism=1)
+        tb.set_bolt("infer", InferenceBolt(
+            ModelConfig(name="lenet5", input_shape=(28, 28, 1),
+                        dtype="float32", seed=0),
+            BatchConfig(max_batch=8, max_wait_ms=10, buckets=(8,))),
+            parallelism=2).shuffle_grouping("spout")
+        tb.set_bolt("sink", BrokerSink(broker, "out", cfg.sink),
+                    parallelism=1).shuffle_grouping("infer")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("swap", cfg, tb.build())
+
+        x = np.random.RandomState(0).rand(1, 28, 28, 1).tolist()
+        payload = _json.dumps({"instances": x})
+
+        async def feed_and_collect(n):
+            start = broker.topic_size("out")
+            for _ in range(n):
+                broker.produce("in", payload)
+            for _ in range(200):
+                if broker.topic_size("out") >= start + n:
+                    break
+                await asyncio.sleep(0.05)
+            assert broker.topic_size("out") == start + n
+            return _json.loads(
+                broker.drain_topic("out")[-1].value)["predictions"]
+
+        before = await feed_and_collect(4)
+        new_cfg = await rt.swap_model("infer", {"seed": 123})
+        assert new_cfg.seed == 123
+        after = await feed_and_collect(4)
+        assert not np.allclose(before, after), "new weights must be live"
+        # every live instance switched
+        for e in rt.bolt_execs["infer"]:
+            assert e.bolt.model_cfg.seed == 123
+        # unknown component / non-inference component / bad field
+        with pytest.raises(KeyError):
+            await rt.swap_model("nope", {"seed": 1})
+        with pytest.raises(TypeError):
+            await rt.swap_model("sink", {"seed": 1})
+        with pytest.raises(TypeError):
+            await rt.swap_model("infer", {"not_a_field": 1})
+        await cluster.shutdown()
+
+    run(go(), timeout=120)

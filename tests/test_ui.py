@@ -349,3 +349,72 @@ def test_ui_logs_negative_bytes_rejected(run):
             await ui.stop()
 
     run(go(), timeout=60)
+
+
+def test_ui_swap_model_action(run):
+    """POST /swap_model rolls the inference component onto a new model
+    config and returns it; bad requests get 4xx."""
+    import numpy as np
+
+    from storm_tpu.config import BatchConfig, ModelConfig
+    from storm_tpu.infer import InferenceBolt
+
+    class OneShotSpout(Spout):
+        def open(self, context, collector):
+            super().open(context, collector)
+            self.sent = False
+
+        async def next_tuple(self):
+            if self.sent:
+                return False
+            self.sent = True
+            import json as _json
+
+            await self.collector.emit(Values([
+                _json.dumps({"instances": np.zeros((1, 28, 28, 1)).tolist()})
+            ]), msg_id=1)
+            return True
+
+        def ack(self, msg_id):
+            pass
+
+        def fail(self, msg_id):
+            pass
+
+    async def go():
+        tb = TopologyBuilder()
+        tb.set_spout("spout", OneShotSpout(), parallelism=1)
+        tb.set_bolt("infer", InferenceBolt(
+            ModelConfig(name="lenet5", input_shape=(28, 28, 1),
+                        dtype="float32", seed=0),
+            BatchConfig(max_batch=4, max_wait_ms=5, buckets=(4,))),
+            parallelism=1).shuffle_grouping("spout")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("demo", Config(), tb.build())
+        ui = await UIServer(cluster, port=0).start()
+        try:
+            st, out = await _http(ui.port, "POST",
+                                  "/api/v1/topology/demo/swap_model",
+                                  {"component": "infer",
+                                   "model": {"seed": 7}})
+            assert st == 200 and out["model"]["seed"] == 7
+            assert rt.bolt_execs["infer"][0].bolt.model_cfg.seed == 7
+
+            st, _ = await _http(ui.port, "POST",
+                                "/api/v1/topology/demo/swap_model",
+                                {"component": "nope", "model": {"seed": 1}})
+            assert st == 404
+            st, _ = await _http(ui.port, "POST",
+                                "/api/v1/topology/demo/swap_model",
+                                {"component": "infer", "model": {}})
+            assert st == 400
+            st, _ = await _http(ui.port, "POST",
+                                "/api/v1/topology/demo/swap_model",
+                                {"component": "infer",
+                                 "model": {"weights": "bogus"}})
+            assert st == 400
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=120)
