@@ -62,7 +62,8 @@ MULTI_MODELS = {
 }
 
 
-def build_multi_topology(broker, max_wait_ms, transfer_dtype=None, max_batch=0):
+def build_multi_topology(broker, max_wait_ms, transfer_dtype=None, max_batch=0,
+                         inflight=2):
     from storm_tpu.config import (
         BatchConfig, Config, ModelConfig, OffsetsConfig, PipelineConfig, ShardingConfig,
     )
@@ -79,7 +80,8 @@ def build_multi_topology(broker, max_wait_ms, transfer_dtype=None, max_batch=0):
             ),
             batch=BatchConfig(max_batch=max_batch or mc["max_batch"],
                               max_wait_ms=max_wait_ms,
-                              buckets=(max_batch,) if max_batch else mc["buckets"]),
+                              buckets=(max_batch,) if max_batch else mc["buckets"],
+                              max_inflight=inflight),
             sharding=ShardingConfig(data_parallel=0),
             offsets=OffsetsConfig(policy="earliest", max_behind=None),
             input_topic=f"{name}-in",
@@ -114,7 +116,8 @@ def run_multi(args) -> None:
     # ---- throughput phase ----------------------------------------------------
     broker = MemoryBroker(default_partitions=4)
     run_cfg, topo = build_multi_topology(
-        broker, max(args.max_wait_ms, 100.0), args.transfer_dtype, args.max_batch)
+        broker, max(args.max_wait_ms, 100.0), args.transfer_dtype, args.max_batch,
+        args.inflight or 4)
     t0 = time.time()
     cluster.submit_topology("bench-multi", run_cfg, topo)
     log(f"submitted + warmed up in {time.time() - t0:.1f}s")
@@ -142,7 +145,8 @@ def run_multi(args) -> None:
     if not args.skip_latency:
         broker2 = MemoryBroker(default_partitions=4)
         run_cfg2, topo2 = build_multi_topology(broker2, args.max_wait_ms,
-                                               args.transfer_dtype, args.max_batch)
+                                               args.transfer_dtype, args.max_batch,
+                                               args.inflight or 2)
         cluster.submit_topology("bench-multi-lat", run_cfg2, topo2)
         rate = max(8.0, throughput * n_dev * 0.3)
         log(f"latency phase: offered {rate:.0f} msg/s (interleaved) for "
