@@ -204,6 +204,17 @@ class InferenceEngine:
         self._scalar_sharding = replicated(self.mesh)
         self.compiled_batches: set = set()
 
+    # ---- memory accounting ---------------------------------------------------
+
+    def param_bytes(self) -> int:
+        """Device bytes held by this engine's params+state (per replica).
+        The multi-model co-residency budget (BASELINE config 5) is the sum
+        of these across live engines — see :func:`engine_inventory`."""
+        return sum(
+            x.nbytes for t in (self.params, self.state)
+            for x in jax.tree.leaves(t) if hasattr(x, "nbytes")
+        )
+
     # ---- shape management ----------------------------------------------------
 
     @property
@@ -318,4 +329,49 @@ def shared_engine(
     with _ENGINES_LOCK:
         if key not in _ENGINES:
             _ENGINES[key] = InferenceEngine(model_cfg, sharding_cfg, batch_cfg)
+            _log_hbm_inventory()
         return _ENGINES[key]
+
+
+def engine_inventory() -> dict:
+    """Live engines in this process and their per-replica HBM param
+    footprints — the multi-model co-residency budget (BASELINE config 5;
+    engines accumulate across pipelines and live model swaps)."""
+    with _ENGINES_LOCK:
+        engines = list(_ENGINES.values())
+    rows = [
+        {
+            "model": e.model_cfg.name,
+            "weights": getattr(e.model_cfg, "weights", "float"),
+            "dtype": str(e.dtype),
+            "param_bytes": e.param_bytes(),
+        }
+        for e in engines
+    ]
+    return {"engines": rows,
+            "total_param_bytes": sum(r["param_bytes"] for r in rows)}
+
+
+def _device_hbm_limit() -> Optional[int]:
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats:
+            return stats.get("bytes_limit")
+    except Exception:  # pragma: no cover - backend-dependent
+        pass
+    return None
+
+
+def _log_hbm_inventory() -> None:
+    # Called with _ENGINES_LOCK held (param_bytes only reads engine attrs).
+    rows = [(e.model_cfg.name, e.param_bytes()) for e in _ENGINES.values()]
+    total = sum(b for _, b in rows)
+    limit = _device_hbm_limit()
+    detail = ", ".join(f"{n}={b / 1e6:.1f}MB" for n, b in rows)
+    logger.info("engine HBM inventory: %s (total %.1fMB)", detail, total / 1e6)
+    if limit and total > 0.85 * limit:
+        logger.warning(
+            "co-resident engine params at %.0f%% of device memory "
+            "(%.1fMB of %.1fMB) — multi-model HBM budget nearly exhausted",
+            100 * total / limit, total / 1e6, limit / 1e6,
+        )

@@ -483,8 +483,15 @@ def main(argv=None) -> int:
 
         from storm_tpu.models import registry_names
 
+        dev = jax.devices()[0]
+        mem = None
+        try:
+            mem = dev.memory_stats()
+        except Exception:
+            pass
         print(json.dumps({
             "devices": [str(d) for d in jax.devices()],
+            "memory_stats": mem,
             "models": registry_names(),
             "version": __import__("storm_tpu").__version__,
         }, indent=2))

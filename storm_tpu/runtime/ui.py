@@ -181,7 +181,9 @@ class UIServer:
             text = await asyncio.to_thread(prometheus_text, regs)
             return 200, _PlainText(text)
         if path == "/api/v1/cluster/summary":
-            return 200, self._cluster_summary()
+            # Off-loop: engine_inventory takes _ENGINES_LOCK, which a model
+            # swap/submit holds for an entire engine build.
+            return 200, await asyncio.to_thread(self._cluster_summary)
         if path == "/api/v1/topology/summary":
             rts = list(self._runtimes().values())
             return 200, {"topologies": await asyncio.to_thread(
@@ -308,9 +310,15 @@ class UIServer:
         return self.cluster.runtimes
 
     def _cluster_summary(self) -> Dict[str, Any]:
+        from storm_tpu.infer.engine import engine_inventory
+
         return {
             "uptime_s": round(time.monotonic() - self._started, 3),
             "topologies": sorted(self._runtimes()),
+            # Multi-model HBM budget: engines co-resident in this process
+            # (empty when topologies run in dist workers — each worker
+            # owns its own engines).
+            "engines": engine_inventory(),
         }
 
     def _topo_summary(self, rt, health: Dict[str, Any] = None) -> Dict[str, Any]:

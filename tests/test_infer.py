@@ -401,3 +401,25 @@ def test_live_model_swap_under_traffic(run):
         await cluster.shutdown()
 
     run(go(), timeout=120)
+
+
+def test_engine_inventory_tracks_coresident_models():
+    """engine_inventory sums per-replica HBM param bytes across the
+    process's live engines (the multi-model budget, BASELINE config 5)."""
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.infer.engine import engine_inventory, shared_engine
+
+    e1 = shared_engine(
+        ModelConfig(name="lenet5", input_shape=(28, 28, 1), dtype="float32"),
+        ShardingConfig(data_parallel=0), BatchConfig(max_batch=4, buckets=(4,)))
+    e2 = shared_engine(
+        ModelConfig(name="mixer_tiny", input_shape=(32, 32, 3),
+                    dtype="float32"),
+        ShardingConfig(data_parallel=0), BatchConfig(max_batch=4, buckets=(4,)))
+    inv = engine_inventory()
+    names = {r["model"] for r in inv["engines"]}
+    assert {"lenet5", "mixer_tiny"} <= names
+    assert e1.param_bytes() > 100_000  # lenet5 f32 ~ a few hundred KB
+    assert inv["total_param_bytes"] >= e1.param_bytes() + e2.param_bytes()
+    for r in inv["engines"]:
+        assert r["param_bytes"] > 0
