@@ -1,7 +1,7 @@
 """Zero-downtime model rollout, observed end to end.
 
-Starts the reference-shaped topology (2x spout -> 4x inference -> 2x sink)
-with a UI server, streams records through it, then rolls the inference
+Starts the reference-shaped topology (2x spout -> 4x inference -> 2x sink),
+streams records through it, then rolls the inference
 component onto new weights with ``swap_model`` while traffic keeps
 flowing — the operational move the reference could not make without a
 rebuild + resubmit (its model ships inside the jar,

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from storm_tpu.models.registry import ModelDef, register
 from storm_tpu.ops import layers as L
+from storm_tpu.ops.fused_norm import residual_layernorm
 
 
 def _mlp_init(rng, dim, hidden):
@@ -47,10 +48,9 @@ def _block(p, x):
     y = jnp.swapaxes(y, 1, 2)
     y = _mlp(p["token"], y)
     y = jnp.swapaxes(y, 1, 2)
-    x = x + y
-    # channel mixing
-    x = x + _mlp(p["channel"], L.layernorm(p["ln2"], x))
-    return x
+    # token-mix residual add + channel-mix LN fused (Pallas on TPU)
+    x, n2 = residual_layernorm(p["ln2"], y, x)
+    return x + _mlp(p["channel"], n2)
 
 
 def _build_mixer(name, num_classes, input_shape, patch, dim, depth,

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from storm_tpu.models.registry import ModelDef, register
 from storm_tpu.ops import layers as L
 from storm_tpu.ops.attention import mha_init, multi_head_attention
+from storm_tpu.ops.fused_norm import residual_layernorm
 
 
 def _block_init(rng, dim, mlp_dim, num_heads):
@@ -30,9 +31,12 @@ def _block_init(rng, dim, mlp_dim, num_heads):
 
 
 def _block(p, x, num_heads):
-    x = x + multi_head_attention(p["attn"], L.layernorm(p["ln1"], x), num_heads)
-    h = L.gelu(L.dense(p["mlp_in"], L.layernorm(p["ln2"], x)))
-    return x + L.dense(p["mlp_out"], h)
+    attn = multi_head_attention(p["attn"], L.layernorm(p["ln1"], x), num_heads)
+    # Residual add + LN2 fused in one Pallas kernel on TPU (one HBM round
+    # trip for the (tokens, dim) activation instead of two).
+    y, n2 = residual_layernorm(p["ln2"], attn, x)
+    h = L.gelu(L.dense(p["mlp_in"], n2))
+    return y + L.dense(p["mlp_out"], h)
 
 
 def build_vit(

@@ -1,0 +1,146 @@
+"""Pallas TPU fused residual-add + LayerNorm.
+
+Why: every ViT/Mixer encoder block computes ``y = x + f(x); out = LN(y)``
+(models/vit.py `_block`, models/mixer.py). Unfused, the (tokens, dim)
+activation makes an extra HBM round trip between the add and the norm;
+this kernel reads x and the branch output once, does add + mean/var +
+scale/shift in VMEM, and writes the residual sum and the normed tensor.
+The reference has no transformer at all (SURVEY.md §5.7) — this serves
+the beyond-parity ViT/Mixer configs in BASELINE.json.
+
+Autodiff: ``pallas_call`` has no automatic VJP, and the same block code
+runs under ``jax.grad`` in the training path (parallel/train.py,
+pipeline dryruns). The op is wrapped in ``jax.custom_vjp``: forward is
+the Pallas kernel (jnp reference off-TPU), backward is the standard
+LayerNorm gradient in plain jnp (XLA fuses it fine; training peak HBM is
+dominated elsewhere).
+
+Layout: inputs flatten to (rows, dim); grid over row blocks, full dim per
+program (dim <= a few thousand for the zoo). Rows pad to the block, dim
+pads to the 128-lane tile; padded columns are masked out of mean/var and
+the ln output (they'd otherwise contribute (0-mean)^2 to the variance).
+
+CPU/tests: ``interpret=True`` runs the kernel under the Pallas
+interpreter; forward + grads are cross-checked against jnp in
+tests/test_ops.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_LANE = 128
+
+
+def _kernel(x_ref, r_ref, g_ref, b_ref, y_ref, o_ref, *, d_valid, eps):
+    x = x_ref[...].astype(jnp.float32)
+    r = r_ref[...].astype(jnp.float32)
+    y = x + r
+    mask = lax.broadcasted_iota(jnp.int32, y.shape, 1) < d_valid
+    ym = jnp.where(mask, y, 0.0)
+    mean = ym.sum(axis=1, keepdims=True) / d_valid
+    var = (jnp.where(mask, y - mean, 0.0) ** 2).sum(axis=1, keepdims=True) / d_valid
+    rstd = lax.rsqrt(var + eps)
+    normed = (y - mean) * rstd * g_ref[0] + b_ref[0]
+    y_ref[...] = jnp.where(mask, y, 0.0).astype(y_ref.dtype)
+    o_ref[...] = jnp.where(mask, normed, 0.0).astype(o_ref.dtype)
+
+
+def _pad2(a, rows, cols):
+    pr = (-a.shape[0]) % rows
+    pc = (-a.shape[1]) % cols
+    if pr or pc:
+        a = jnp.pad(a, ((0, pr), (0, pc)))
+    return a
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "block_rows", "interpret"))
+def _fused_fwd_pallas(x2, r2, g, b, *, eps, block_rows=256, interpret=False):
+    rows, d = x2.shape
+    # Row block: round rows up to the 8-sublane tile, capped at block_rows
+    # only when that cap does not force a near-empty trailing block (e.g.
+    # rows=300 with a 256 cap would pad to 512 and norm 212 garbage rows).
+    r8 = ((max(8, rows) + 7) // 8) * 8
+    br = r8 if r8 <= 2 * block_rows else block_rows
+    xp = _pad2(x2, br, _LANE)
+    rp = _pad2(r2, br, _LANE)
+    dp = xp.shape[1]
+    gp = jnp.pad(g.astype(jnp.float32), (0, dp - d)).reshape(1, dp)
+    bp = jnp.pad(b.astype(jnp.float32), (0, dp - d)).reshape(1, dp)
+    grid = (xp.shape[0] // br,)
+    y, out = pl.pallas_call(
+        functools.partial(_kernel, d_valid=d, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, dp), lambda i: (i, 0)),
+            pl.BlockSpec((br, dp), lambda i: (i, 0)),
+            pl.BlockSpec((1, dp), lambda i: (0, 0)),
+            pl.BlockSpec((1, dp), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, dp), lambda i: (i, 0)),
+            pl.BlockSpec((br, dp), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(xp.shape, x2.dtype),
+            jax.ShapeDtypeStruct(xp.shape, x2.dtype),
+        ],
+        interpret=interpret,
+    )(xp, rp, gp, bp)
+    return y[:rows, :d], out[:rows, :d]
+
+
+def _reference(x2, r2, g, b, eps):
+    y = x2 + r2
+    yf = y.astype(jnp.float32)
+    mean = yf.mean(axis=-1, keepdims=True)
+    var = yf.var(axis=-1, keepdims=True)
+    normed = (yf - mean) * lax.rsqrt(var + eps) * g + b
+    return y, normed.astype(x2.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused(x2, r2, g, b, eps):
+    from storm_tpu.ops.platform import use_pallas
+
+    if use_pallas():
+        return _fused_fwd_pallas(x2, r2, g, b, eps=eps)
+    return _reference(x2, r2, g, b, eps)
+
+
+def _fused_fwd(x2, r2, g, b, eps):
+    y, out = _fused(x2, r2, g, b, eps)
+    return (y, out), (x2, r2, g, b)
+
+
+def _fused_bwd(eps, res, cots):
+    # Backward = jax's own vjp of the unfused reference. Writing the LN
+    # gradient by hand is easy to get numerically right but WRONG under
+    # shard_map's varying-axis tracking: autodiff of the unfused op
+    # transposes the implicit param broadcast (pvary) into a psum over the
+    # data axes, which a hand-rolled sum cannot know to do. Recomputing
+    # the cheap forward here costs one fused elementwise pass.
+    x2, r2, g, b = res
+    _, vjp = jax.vjp(lambda *a: _reference(*a, eps), x2, r2, g, b)
+    return vjp(cots)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def residual_layernorm(p: dict, branch: jnp.ndarray, x: jnp.ndarray,
+                       eps: float = 1e-6):
+    """``y = x + branch; out = LayerNorm_p(y)`` — fused on TPU.
+
+    Returns ``(y, out)`` so the caller keeps the residual stream.
+    ``p`` is the `layernorm_init` dict ({"scale", "bias"})."""
+    *lead, d = x.shape
+    x2 = x.reshape(-1, d)
+    b2 = branch.reshape(-1, d)
+    y, out = _fused(b2, x2, p["scale"], p["bias"], eps)
+    return y.reshape(*lead, d), out.reshape(*lead, d)
