@@ -286,6 +286,10 @@ def main() -> None:
     ap.add_argument("--max-batch", type=int, default=0, help="override config max_batch")
     ap.add_argument("--buckets", default="",
                     help="comma-separated padding buckets override, e.g. 64,1024")
+    ap.add_argument("--eager", action="store_true",
+                    help="work-conserving dispatch in the latency phase: "
+                         "flush when a device slot frees instead of aging "
+                         "to max_wait_ms")
     ap.add_argument("--inflight", type=int, default=0,
                     help="batches in flight per operator (BatchConfig."
                          "max_inflight); 0 = auto (4 for the throughput "
@@ -374,6 +378,7 @@ def main() -> None:
             max_wait_ms=args.max_wait_ms,
             buckets=buckets,
             max_inflight=args.inflight or 2,
+            eager=args.eager,
         )
         broker2 = MemoryBroker(default_partitions=4)
         run_cfg2, topo2 = build_topology(cfg, broker2, lat_batch_cfg, args.transfer_dtype,

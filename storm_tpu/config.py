@@ -36,6 +36,12 @@ class BatchConfig:
     # high per-launch dispatch latency (remote/tunneled devices) at the
     # cost of tail latency.
     max_inflight: int = 2
+    # Work-conserving dispatch: flush the pending batch whenever an
+    # in-flight slot is free instead of waiting out max_wait_ms. Batch
+    # size then adapts to load (idle device -> tiny batches, low latency;
+    # saturated device -> slots stay busy, batches fill toward max_batch
+    # while waiting). The deadline still applies as a fallback bound.
+    eager: bool = False
 
     def __post_init__(self) -> None:
         self.buckets = tuple(sorted(set(int(b) for b in self.buckets)))

@@ -107,6 +107,11 @@ class InferenceBolt(Bolt):
         self._inflight: Set[asyncio.Task] = set()
         self._dispatch_sem = asyncio.Semaphore(
             max(1, self.batch_cfg.max_inflight))
+        self._eager = getattr(self.batch_cfg, "eager", False)
+        # Eager dispatches created but not yet through sem.acquire():
+        # locked() alone is optimistic (the task acquires a tick later),
+        # and two same-tick arrivals would otherwise each ship a tiny batch.
+        self._eager_pending = 0
         m = context.metrics
         cid = context.component_id
         self._m_batch = m.histogram(cid, "batch_size")
@@ -152,6 +157,20 @@ class InferenceBolt(Bolt):
         )
 
     def _kick_flush(self) -> None:
+        if self._eager and len(self.batcher) and \
+                not self._dispatch_sem.locked() and not self._eager_pending:
+            # Work-conserving: a device slot is free and records are
+            # waiting — dispatch now rather than age toward the deadline.
+            # Under load every slot is busy, this branch never fires, and
+            # batches fill toward max_batch while they queue.
+            batch = self.batcher.take_all()
+            if batch is not None:
+                self._eager_pending += 1
+                task = asyncio.get_running_loop().create_task(
+                    self._dispatch(batch, eager=True))
+                self._inflight.add(task)
+                task.add_done_callback(self._inflight.discard)
+                return
         if len(self.batcher) and (self._flush_task is None or self._flush_task.done()):
             self._flush_task = asyncio.get_running_loop().create_task(
                 self._deadline_flush()
@@ -212,8 +231,10 @@ class InferenceBolt(Bolt):
             if batch is not None:
                 await self._dispatch(batch)
 
-    async def _dispatch(self, batch: Batch) -> None:
+    async def _dispatch(self, batch: Batch, eager: bool = False) -> None:
         await self._dispatch_sem.acquire()
+        if eager:
+            self._eager_pending -= 1
         task = asyncio.get_running_loop().create_task(self._run_batch(batch))
         self._inflight.add(task)
         task.add_done_callback(self._inflight.discard)
@@ -241,6 +262,8 @@ class InferenceBolt(Bolt):
                 self._complete(item.payload, False)
         finally:
             self._dispatch_sem.release()
+            # Freed a slot: eagerly pull whatever queued while we ran.
+            self._kick_flush()
 
     async def swap_model(self, model_cfg: ModelConfig) -> None:
         """Zero-downtime model swap (the reference ships its model inside

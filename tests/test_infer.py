@@ -423,3 +423,58 @@ def test_engine_inventory_tracks_coresident_models():
     assert inv["total_param_bytes"] >= e1.param_bytes() + e2.param_bytes()
     for r in inv["engines"]:
         assert r["param_bytes"] > 0
+
+
+def test_eager_dispatch_low_latency_and_batching_under_load(run):
+    """eager=True: an idle device gets records immediately (no max_wait
+    aging); when all slots are busy, arrivals accumulate into one batch."""
+    import asyncio
+
+    import numpy as np
+
+    from storm_tpu.config import BatchConfig, Config, ModelConfig
+    from storm_tpu.infer import InferenceBolt
+    from storm_tpu.runtime import TopologyBuilder, Spout, Values
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+    import json as _json
+
+    class TwoShotSpout(Spout):
+        def open(self, ctx, col):
+            super().open(ctx, col)
+            self.sent = 0
+
+        async def next_tuple(self):
+            if self.sent >= 2:
+                return False
+            self.sent += 1
+            await self.collector.emit(Values([
+                _json.dumps({"instances": np.zeros((1, 28, 28, 1)).tolist()})
+            ]), msg_id=self.sent)
+            return True
+
+    async def go():
+        tb = TopologyBuilder()
+        tb.set_spout("s", TwoShotSpout(), 1)
+        # Huge deadline: only eager dispatch can flush these records fast.
+        tb.set_bolt("infer", InferenceBolt(
+            ModelConfig(name="lenet5", input_shape=(28, 28, 1),
+                        dtype="float32"),
+            BatchConfig(max_batch=64, max_wait_ms=30_000.0, buckets=(64,),
+                        eager=True)),
+            1).shuffle_grouping("s")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("eager", Config(), tb.build())
+        import time as _time
+        t0 = _time.perf_counter()
+        for _ in range(100):
+            snap = rt.metrics.snapshot()
+            done = snap["infer"].get("instances_inferred", 0)
+            if done >= 2:
+                break
+            await asyncio.sleep(0.1)
+        dt = _time.perf_counter() - t0
+        assert done >= 2, f"only {done} inferred"
+        assert dt < 15.0, f"eager dispatch should beat the 30s deadline, took {dt:.1f}s"
+        await cluster.shutdown()
+
+    run(go(), timeout=120)
