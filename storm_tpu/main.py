@@ -256,6 +256,9 @@ def _ctl(args) -> int:
         rc, out = call("POST", f"/api/v1/topology/{topo}/rebalance",
                        {"component": args.component,
                         "parallelism": args.parallelism})
+    elif cmd == "profile":
+        rc, out = call("POST", f"/api/v1/topology/{topo}/profile",
+                       {"log_dir": args.log_dir, "seconds": args.seconds})
     elif cmd == "swap-model":
         overrides = {}
         for kv in args.set:
@@ -374,6 +377,13 @@ def main(argv=None) -> int:
     c.add_argument("topology")
     c.add_argument("component")
     c.add_argument("parallelism", type=int)
+    c = ctlsub.add_parser(
+        "profile",
+        help="capture a jax profiler trace (device+host timelines, "
+             "TensorBoard-readable) on the daemon for N seconds")
+    c.add_argument("topology")
+    c.add_argument("log_dir")
+    c.add_argument("--seconds", type=float, default=5.0)
     c = ctlsub.add_parser(
         "swap-model",
         help="live model swap: apply ModelConfig field overrides to a "

@@ -25,6 +25,8 @@ Admin routes (POST, like Storm UI's topology actions)
     POST /api/v1/topology/{name}/drain        deactivate + wait in-flight
     POST /api/v1/topology/{name}/rebalance    body {"component":, "parallelism":}
     POST /api/v1/topology/{name}/kill         body {"wait_secs": 0} (optional)
+    POST /api/v1/topology/{name}/swap_model   body {"component":, "model": {...}}
+    POST /api/v1/topology/{name}/profile      body {"log_dir":, "seconds": 5}
 
 Everything returns ``application/json``. The server binds 127.0.0.1 by
 default — expose it via a reverse proxy if needed; there is no auth layer,
@@ -67,6 +69,7 @@ class UIServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = time.monotonic()
         self._kill_tasks: set = set()
+        self._profile_task = None
 
     async def start(self) -> "UIServer":
         self._server = await asyncio.start_server(self._serve, self.host, self.port)
@@ -84,6 +87,15 @@ class UIServer:
             # Exceptions are logged by _kill_done; never let a failing kill
             # abort the caller's shutdown sequence.
             await asyncio.gather(*list(self._kill_tasks), return_exceptions=True)
+        if self._profile_task is not None and not self._profile_task.done():
+            # A capture sleeps in a worker thread; wait it out so
+            # jax.profiler.stop_trace runs before the loop tears down
+            # (cancel() couldn't interrupt the thread anyway).
+            await asyncio.gather(self._profile_task, return_exceptions=True)
+
+    def _profile_done(self, task) -> None:
+        if not task.cancelled() and task.exception() is not None:
+            log.error("profile capture failed: %r", task.exception())
 
     def _kill_done(self, task) -> None:
         self._kill_tasks.discard(task)
@@ -404,6 +416,37 @@ class UIServer:
             await rt.deactivate()
             ok = await rt.drain(timeout_s=timeout_s)
             return 200, {"status": "INACTIVE", "drained": bool(ok)}
+        if action == "profile":
+            # On-demand jax profiler capture: device+host timelines for
+            # ``seconds`` into ``log_dir`` (TensorBoard-readable). The
+            # capture runs as a background task; the response returns
+            # immediately with the target dir.
+            log_dir = args.get("log_dir")
+            try:
+                seconds = float(args.get("seconds", 5.0))
+            except (TypeError, ValueError):
+                return 400, {"error": "seconds must be a number"}
+            import math
+
+            if not log_dir or not math.isfinite(seconds) or \
+                    not 0 < seconds <= 300:
+                return 400, {"error": "need log_dir and 0 < seconds <= 300"}
+            if self._profile_task is not None and not self._profile_task.done():
+                return 409, {"error": "a profile capture is already running"}
+
+            async def capture():
+                from storm_tpu.runtime.tracing import device_trace
+
+                def run_trace():
+                    with device_trace(log_dir):
+                        time.sleep(seconds)
+
+                await asyncio.to_thread(run_trace)
+
+            self._profile_task = asyncio.ensure_future(capture())
+            self._profile_task.add_done_callback(self._profile_done)
+            return 200, {"log_dir": log_dir, "seconds": seconds,
+                         "status": "capturing"}
         if action == "swap_model":
             component = args.get("component")
             overrides = args.get("model")

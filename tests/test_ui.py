@@ -418,3 +418,34 @@ def test_ui_swap_model_action(run):
             await cluster.shutdown()
 
     run(go(), timeout=120)
+
+
+def test_ui_profile_capture(run, tmp_path):
+    """POST /profile captures a jax trace into log_dir; concurrent
+    captures are rejected with 409."""
+    import os
+
+    async def go():
+        cluster, ui = await _cluster_with_ui()
+        try:
+            d = str(tmp_path / "trace")
+            st, out = await _http(ui.port, "POST",
+                                  "/api/v1/topology/demo/profile",
+                                  {"log_dir": d, "seconds": 0.5})
+            assert st == 200 and out["status"] == "capturing"
+            st2, _ = await _http(ui.port, "POST",
+                                 "/api/v1/topology/demo/profile",
+                                 {"log_dir": d, "seconds": 0.5})
+            assert st2 == 409
+            await asyncio.wait_for(ui._profile_task, timeout=30)
+            found = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+            assert found, "profiler wrote no trace files"
+            st, _ = await _http(ui.port, "POST",
+                                "/api/v1/topology/demo/profile",
+                                {"log_dir": "", "seconds": 1})
+            assert st == 400
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=90)
