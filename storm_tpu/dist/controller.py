@@ -351,6 +351,16 @@ class DistCluster:
             self._swaps[component] = merged
         return resp.get("model", {})
 
+    def profile(self, worker: int, log_dir: str, seconds: float) -> dict:
+        """Start a jax profiler capture on one worker (device timelines
+        live with the worker's engines, not the controller)."""
+        with self._lock:
+            if not 0 <= worker < len(self.clients):
+                raise KeyError(f"no worker {worker}")
+            client = self.clients[worker]
+        return client.control(
+            "profile", log_dir=log_dir, seconds=seconds)
+
     # ---- failure detection + elastic recovery (SURVEY.md §5.3) ---------------
 
     def start_monitor(

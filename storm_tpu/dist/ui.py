@@ -154,6 +154,11 @@ class DistRuntimeView:
         return await asyncio.to_thread(
             self._dist.swap_model, component, overrides)
 
+    async def profile(self, log_dir: str, seconds: float,
+                      worker: int = 0) -> dict:
+        return await asyncio.to_thread(
+            self._dist.profile, worker, log_dir, seconds)
+
     async def worker_logs(self, index: int, tail_bytes: int = 16384) -> str:
         return await asyncio.to_thread(self._dist.worker_logs, index, tail_bytes)
 

@@ -392,6 +392,8 @@ class WorkerServer:
         self.loop = asyncio.new_event_loop()
         self.rt: Optional[DistRuntime] = None
         self._broker = None
+        self._profile_thread: Optional[threading.Thread] = None
+        self._profile_lock = threading.Lock()
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=16),
             options=transport._OPTS,
@@ -465,6 +467,30 @@ class WorkerServer:
             else:
                 self._run_on_loop(self.rt.resize_remote_group(component, new))
             return {"ok": True, "previous": prev}
+        if cmd == "profile":
+            log_dir = req["log_dir"]
+            seconds = float(req["seconds"])
+
+            def run_trace():
+                from storm_tpu.runtime.tracing import device_trace
+
+                try:
+                    with device_trace(log_dir):
+                        time.sleep(seconds)
+                except Exception:
+                    log.exception("profile capture failed")
+
+            # Control RPCs run on a 16-thread gRPC pool: the
+            # check-then-start must be atomic or two captures race into
+            # jax.profiler (the second start_trace raises, invisibly).
+            with self._profile_lock:
+                if self._profile_thread is not None and \
+                        self._profile_thread.is_alive():
+                    return {"error": "a profile capture is already running"}
+                self._profile_thread = threading.Thread(
+                    target=run_trace, name="profile-capture")
+                self._profile_thread.start()
+            return {"ok": True, "log_dir": log_dir, "seconds": seconds}
         if cmd == "swap_model":
             import dataclasses as _dc
 
@@ -515,6 +541,11 @@ class WorkerServer:
             self.loop.run_forever()
         finally:
             self._server.stop(1).wait()
+            # Let an in-flight capture reach jax.profiler.stop_trace so the
+            # trace on disk is complete (same invariant as UIServer.stop).
+            t = self._profile_thread
+            if t is not None and t.is_alive():
+                t.join(timeout=310)
 
     def _wait_stop(self) -> None:
         self._stop.wait()

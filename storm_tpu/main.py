@@ -258,7 +258,8 @@ def _ctl(args) -> int:
                         "parallelism": args.parallelism})
     elif cmd == "profile":
         rc, out = call("POST", f"/api/v1/topology/{topo}/profile",
-                       {"log_dir": args.log_dir, "seconds": args.seconds})
+                       {"log_dir": args.log_dir, "seconds": args.seconds,
+                        "worker": args.worker})
     elif cmd == "swap-model":
         overrides = {}
         for kv in args.set:
@@ -384,6 +385,8 @@ def main(argv=None) -> int:
     c.add_argument("topology")
     c.add_argument("log_dir")
     c.add_argument("--seconds", type=float, default=5.0)
+    c.add_argument("--worker", type=int, default=0,
+                   help="dist mode: worker index to capture on")
     c = ctlsub.add_parser(
         "swap-model",
         help="live model swap: apply ModelConfig field overrides to a "

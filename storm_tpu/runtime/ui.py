@@ -431,6 +431,24 @@ class UIServer:
             if not log_dir or not math.isfinite(seconds) or \
                     not 0 < seconds <= 300:
                 return 400, {"error": "need log_dir and 0 < seconds <= 300"}
+            if hasattr(rt, "profile"):
+                # Dist runtime: capture on the worker owning the engines
+                # (body {"worker": N}), not in the controller process.
+                try:
+                    worker = int(args.get("worker", 0))
+                except (TypeError, ValueError):
+                    return 400, {"error": "worker must be an int"}
+                try:
+                    resp = await rt.profile(log_dir, seconds, worker)
+                except KeyError as e:
+                    return 404, {"error": str(e)}
+                except RuntimeError as e:
+                    if "already running" in str(e):
+                        return 409, {"error": str(e)}
+                    raise
+                return 200, {"log_dir": log_dir, "seconds": seconds,
+                             "worker": worker, "status": "capturing",
+                             **{k: v for k, v in resp.items() if k != "ok"}}
             if self._profile_task is not None and not self._profile_task.done():
                 return 409, {"error": "a profile capture is already running"}
 

@@ -149,3 +149,62 @@ def test_dist_metrics_prometheus_facade():
     assert 'storm_tpu_instances_inferred_total{topology="dist-topo",component="infer"} 42' in text
     assert 'storm_tpu_queue_fill{topology="dist-topo",component="infer"} 0.5' in text
     assert 'storm_tpu_device_ms_count{topology="dist-topo",component="infer"} 3' in text
+
+
+@pytest.mark.slow
+def test_dist_ui_profile_routes_to_worker(run, tmp_path):
+    """POST /profile on the dist UI captures a trace on the named worker
+    process; unknown worker indexes 404."""
+    import os
+
+    stub = KafkaStubBroker(partitions=1)
+    try:
+        cfg = Config()
+        cfg.broker.kind = "kafka"
+        cfg.broker.bootstrap = f"127.0.0.1:{stub.port}"
+        cfg.broker.input_topic = "pr-in"
+        cfg.broker.output_topic = "pr-out"
+        cfg.model.name = "lenet5"
+        cfg.model.dtype = "float32"
+        cfg.topology.spout_parallelism = 1
+        cfg.topology.inference_parallelism = 1
+        cfg.topology.sink_parallelism = 1
+
+        with DistCluster(1, env={"JAX_PLATFORMS": "cpu",
+                                 "STORM_TPU_PLATFORM": "cpu"}) as cluster:
+            cluster.submit("dist-prof", cfg, builder="standard")
+
+            import asyncio
+
+            async def with_ui():
+                from storm_tpu.dist.ui import start_dist_ui
+
+                ui = await start_dist_ui(cluster, "dist-prof", port=0)
+                loop = asyncio.get_running_loop()
+                d = str(tmp_path / "trace")
+                try:
+                    st, out = await loop.run_in_executor(
+                        None, _http, ui.port, "POST",
+                        "/api/v1/topology/dist-prof/profile",
+                        {"log_dir": d, "seconds": 0.5, "worker": 0})
+                    assert st == 200 and out["status"] == "capturing", out
+                    deadline = loop.time() + 30
+                    files = []
+                    while loop.time() < deadline:
+                        files = [f for _, _, fs in os.walk(d) for f in fs]
+                        if files:
+                            break
+                        await asyncio.sleep(0.25)
+                    assert files, "worker wrote no trace files"
+                    st, _ = await loop.run_in_executor(
+                        None, _http, ui.port, "POST",
+                        "/api/v1/topology/dist-prof/profile",
+                        {"log_dir": d, "seconds": 1, "worker": 99})
+                    assert st == 404
+                finally:
+                    await ui.stop()
+
+            run(with_ui(), timeout=90)
+            cluster.kill()
+    finally:
+        stub.close()
