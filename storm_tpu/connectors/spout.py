@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import re
 import threading
 import time
 import uuid
@@ -33,6 +34,20 @@ from storm_tpu.config import OffsetsConfig
 from storm_tpu.connectors.memory import MemoryBroker, Record
 from storm_tpu.runtime.base import Spout, TopologyContext, OutputCollector
 from storm_tpu.runtime.tuples import Values
+
+
+def parse_seek_position(s):
+    """"earliest" | "latest" | integer string -> seek position.
+    Raises ValueError on anything else (shared by the HTTP route and the
+    ctl CLI so both reject malformed positions identically)."""
+    if s in ("earliest", "latest"):
+        return s
+    if isinstance(s, int):
+        return s
+    if isinstance(s, str) and re.fullmatch(r"-?[0-9]+", s):
+        return int(s)
+    raise ValueError(
+        f"seek position must be earliest|latest|<int>, got {s!r}")
 
 
 class BrokerSpout(Spout):
@@ -89,6 +104,7 @@ class BrokerSpout(Spout):
                 if p % context.parallelism == context.task_index
             ]
         self.positions: Dict[int, int] = {}
+        self._seek = None  # pending request_seek position
         self.pending: Dict[Tuple[int, int], Record] = {}
         self.replay: Deque[Record] = collections.deque()
         self.dropped = 0
@@ -164,9 +180,48 @@ class BrokerSpout(Spout):
             parts = await asyncio.to_thread(m.join)
             await asyncio.to_thread(self._apply_assignment, parts)
 
+    def request_seek(self, position) -> None:
+        """Reposition every owned partition at the next poll (the live
+        replay/backfill op — impossible in the reference, whose spout
+        pins start-at-latest and ignores stored offsets,
+        MainTopology.java:101-103). ``position``: ``"earliest"`` |
+        ``"latest"`` | absolute offset (int >= 0) | negative int = that
+        many records behind latest. Queued replays are discarded;
+        in-flight tuples still complete, so seeking backward duplicates
+        their records (the at-least-once direction)."""
+        if position not in ("earliest", "latest") and not isinstance(position, int):
+            raise ValueError(f"bad seek position {position!r}")
+        self._seek = position
+
+    def _apply_seek(self, position) -> None:
+        self.replay.clear()
+        for p in self.my_partitions:
+            if position == "earliest":
+                pos = self.broker.earliest_offset(self.topic, p)
+            elif position == "latest":
+                pos = self.broker.latest_offset(self.topic, p)
+            elif position < 0:
+                pos = max(self.broker.earliest_offset(self.topic, p),
+                          self.broker.latest_offset(self.topic, p) + position)
+            else:
+                # Clamp to the log's [earliest, latest]: an out-of-range
+                # absolute offset would wedge wire brokers in a permanent
+                # fetch-error loop.
+                pos = max(self.broker.earliest_offset(self.topic, p),
+                          min(position,
+                              self.broker.latest_offset(self.topic, p)))
+            self.positions[p] = pos
+
     async def next_tuple(self) -> bool:
         if self._membership is not None:
             await self._group_poll()
+        if self._seek is not None:
+            position, self._seek = self._seek, None
+            if self._blocking:
+                await asyncio.to_thread(self._apply_seek, position)
+            else:
+                self._apply_seek(position)
+            return True
         # Replays first: failed trees take priority over new data.
         if self.replay:
             entry = self.replay.popleft()

@@ -351,6 +351,26 @@ class DistCluster:
             self._swaps[component] = merged
         return resp.get("model", {})
 
+    def seek(self, component: str, position) -> int:
+        """Reposition a spout component on its hosting worker."""
+        with self._lock:
+            w = self._placement.get(component)
+            if w is None:
+                raise KeyError(component)
+            client = self.clients[w]
+        try:
+            return int(client.control(
+                "seek", component=component, position=position)["instances"])
+        except RuntimeError as e:
+            # Re-type worker-side errors (serialized as "TypeName: msg")
+            # so the UI's 404/400 mapping matches local mode.
+            msg = str(e)
+            if "KeyError" in msg:
+                raise KeyError(component) from e
+            if "TypeError" in msg:
+                raise TypeError(msg) from e
+            raise
+
     def profile(self, worker: int, log_dir: str, seconds: float) -> dict:
         """Start a jax profiler capture on one worker (device timelines
         live with the worker's engines, not the controller)."""

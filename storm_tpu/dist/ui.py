@@ -154,6 +154,9 @@ class DistRuntimeView:
         return await asyncio.to_thread(
             self._dist.swap_model, component, overrides)
 
+    async def seek(self, component: str, position) -> int:
+        return await asyncio.to_thread(self._dist.seek, component, position)
+
     async def profile(self, log_dir: str, seconds: float,
                       worker: int = 0) -> dict:
         return await asyncio.to_thread(

@@ -467,6 +467,10 @@ class WorkerServer:
             else:
                 self._run_on_loop(self.rt.resize_remote_group(component, new))
             return {"ok": True, "previous": prev}
+        if cmd == "seek":
+            n = self._run_on_loop(
+                self.rt.seek(req["component"], req["position"]))
+            return {"ok": True, "instances": n}
         if cmd == "profile":
             log_dir = req["log_dir"]
             seconds = float(req["seconds"])

@@ -256,6 +256,16 @@ def _ctl(args) -> int:
         rc, out = call("POST", f"/api/v1/topology/{topo}/rebalance",
                        {"component": args.component,
                         "parallelism": args.parallelism})
+    elif cmd == "seek":
+        from storm_tpu.connectors.spout import parse_seek_position
+
+        try:
+            pos = parse_seek_position(args.position)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        rc, out = call("POST", f"/api/v1/topology/{topo}/seek",
+                       {"component": args.component, "position": pos})
     elif cmd == "profile":
         rc, out = call("POST", f"/api/v1/topology/{topo}/profile",
                        {"log_dir": args.log_dir, "seconds": args.seconds,
@@ -378,6 +388,13 @@ def main(argv=None) -> int:
     c.add_argument("topology")
     c.add_argument("component")
     c.add_argument("parallelism", type=int)
+    c = ctlsub.add_parser(
+        "seek",
+        help="reposition a spout's consumption: earliest|latest|<offset>|"
+             "-<records-behind-latest> (live replay/backfill)")
+    c.add_argument("topology")
+    c.add_argument("component")
+    c.add_argument("position")
     c = ctlsub.add_parser(
         "profile",
         help="capture a jax profiler trace (device+host timelines, "

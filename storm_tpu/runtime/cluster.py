@@ -381,6 +381,19 @@ class TopologyRuntime:
             for e in pending:
                 await e.bolt.swap_model(new_cfg)
 
+    async def seek(self, component_id: str, position) -> int:
+        """Reposition a spout component's consumption (replay/backfill).
+        Returns the number of instances repositioned."""
+        execs = self.spout_execs.get(component_id)
+        if execs is None:
+            raise KeyError(component_id)
+        seekable = [e for e in execs if hasattr(e.spout, "request_seek")]
+        if not seekable:
+            raise TypeError(f"component {component_id!r} is not seekable")
+        for e in seekable:
+            e.spout.request_seek(position)
+        return len(seekable)
+
     async def rebalance(self, component_id: str, parallelism: int) -> None:
         """Change a component's parallelism live — the framework op the
         reference's README frames as 'rebuild with more bolts'

@@ -27,6 +27,7 @@ Admin routes (POST, like Storm UI's topology actions)
     POST /api/v1/topology/{name}/kill         body {"wait_secs": 0} (optional)
     POST /api/v1/topology/{name}/swap_model   body {"component":, "model": {...}}
     POST /api/v1/topology/{name}/profile      body {"log_dir":, "seconds": 5}
+    POST /api/v1/topology/{name}/seek         body {"component":, "position":}
 
 Everything returns ``application/json``. The server binds 127.0.0.1 by
 default — expose it via a reverse proxy if needed; there is no auth layer,
@@ -416,6 +417,24 @@ class UIServer:
             await rt.deactivate()
             ok = await rt.drain(timeout_s=timeout_s)
             return 200, {"status": "INACTIVE", "drained": bool(ok)}
+        if action == "seek":
+            from storm_tpu.connectors.spout import parse_seek_position
+
+            component = args.get("component")
+            try:
+                position = parse_seek_position(args.get("position"))
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            if not component:
+                return 400, {"error": "need component"}
+            try:
+                n = await rt.seek(component, position)
+            except KeyError:
+                return 404, {"error": f"no component {component!r}"}
+            except TypeError as e:
+                return 400, {"error": str(e)}
+            return 200, {"component": component, "position": position,
+                         "instances": n}
         if action == "profile":
             # On-demand jax profiler capture: device+host timelines for
             # ``seconds`` into ``log_dir`` (TensorBoard-readable). The

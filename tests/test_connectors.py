@@ -383,3 +383,77 @@ def test_spout_group_protocol_requires_wire_broker():
 
     with pytest.raises(ValueError, match="wire-protocol broker"):
         spout.open(Ctx(), None)
+
+
+def test_spout_seek_replays_and_skips(run):
+    """request_seek('earliest') reprocesses the log; seek('latest') skips
+    backlog; negative seek replays the last N records."""
+    import asyncio
+    import json as _json
+
+    from storm_tpu.config import Config
+    from storm_tpu.connectors import BrokerSpout, MemoryBroker
+    from storm_tpu.runtime import Bolt, TopologyBuilder
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    broker = MemoryBroker(default_partitions=2)
+    for i in range(10):
+        broker.produce("t", _json.dumps({"i": i}))
+
+    class Count(Bolt):
+        seen = []
+
+        async def execute(self, t):
+            Count.seen.append(t.values[0])
+            self.collector.ack(t)
+
+    async def settle_at(n, timeout=15.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if len(Count.seen) >= n:
+                await asyncio.sleep(0.3)  # let any extras surface
+                return
+            await asyncio.sleep(0.05)
+        raise AssertionError(f"timed out at {len(Count.seen)}/{n}")
+
+    async def go():
+        from storm_tpu.connectors.spout import OffsetsConfig
+
+        Count.seen = []
+        tb = TopologyBuilder()
+        tb.set_spout("s", BrokerSpout(
+            broker, "t", OffsetsConfig(policy="earliest")), 1)
+        tb.set_bolt("c", Count(), 1).shuffle_grouping("s")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("seek", Config(), tb.build())
+        await settle_at(10)
+        assert len(Count.seen) == 10
+
+        # full replay
+        n = await rt.seek("s", "earliest")
+        assert n == 1
+        await settle_at(20)
+        assert len(Count.seen) == 20
+
+        # seek latest: new backlog produced BEFORE the seek applies is
+        # skipped once the spout repositions
+        await rt.seek("s", "latest")
+        await asyncio.sleep(0.3)
+        before = len(Count.seen)
+        broker.produce("t", _json.dumps({"i": 99}))
+        await settle_at(before + 1)
+        assert len(Count.seen) == before + 1
+
+        # negative: replay ~last 2 per partition
+        await rt.seek("s", -2)
+        await asyncio.sleep(0.5)
+        assert len(Count.seen) > before + 1
+
+        # unknown / non-spout components error
+        with pytest.raises(KeyError):
+            await rt.seek("nope", "earliest")
+        with pytest.raises(KeyError):
+            await rt.seek("c", "earliest")
+        await cluster.shutdown()
+
+    run(go(), timeout=60)

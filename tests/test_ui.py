@@ -449,3 +449,46 @@ def test_ui_profile_capture(run, tmp_path):
             await cluster.shutdown()
 
     run(go(), timeout=90)
+
+
+def test_ui_seek_action(run):
+    """POST /seek repositions the spout; bad positions 400."""
+
+    async def go():
+        from storm_tpu.config import Config as _Config
+        from storm_tpu.connectors import BrokerSpout, MemoryBroker
+
+        broker = MemoryBroker()
+        for i in range(5):
+            broker.produce("t", json.dumps({"i": i}))
+        tb = TopologyBuilder()
+        from storm_tpu.connectors.spout import OffsetsConfig
+
+        tb.set_spout("s", BrokerSpout(broker, "t",
+                     OffsetsConfig(policy="earliest")), 1)
+        tb.set_bolt("e", EchoBolt(), 1).shuffle_grouping("s")
+        cluster = AsyncLocalCluster()
+        await cluster.submit("sk", _Config(), tb.build())
+        ui = await UIServer(cluster, port=0).start()
+        try:
+            st, out = await _http(ui.port, "POST",
+                                  "/api/v1/topology/sk/seek",
+                                  {"component": "s", "position": "earliest"})
+            assert st == 200 and out["instances"] == 1
+            st, out = await _http(ui.port, "POST",
+                                  "/api/v1/topology/sk/seek",
+                                  {"component": "s", "position": "-3"})
+            assert st == 200 and out["position"] == -3
+            st, _ = await _http(ui.port, "POST",
+                                "/api/v1/topology/sk/seek",
+                                {"component": "s", "position": "sideways"})
+            assert st == 400
+            st, _ = await _http(ui.port, "POST",
+                                "/api/v1/topology/sk/seek",
+                                {"component": "zz", "position": "latest"})
+            assert st == 404
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
