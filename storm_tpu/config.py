@@ -197,10 +197,17 @@ class BrokerConfig:
     # 'v1' = 0.11-era message sets (the reference's broker generation);
     # 'v2' = KIP-98 record batches (CRC32C), what modern brokers store.
     message_format: str = "v1"
+    # KIP-98 idempotent produce (requires message_format='v2'): retried
+    # sends reuse their sequence, so the broker appends at most once —
+    # the sink's retry path stops duplicating records.
+    idempotent: bool = False
 
     def __post_init__(self) -> None:
         if self.kind not in ("memory", "kafka"):
             raise ValueError(f"broker.kind must be memory|kafka, got {self.kind!r}")
+        if self.idempotent and self.message_format != "v2":
+            raise ValueError(
+                "broker.idempotent requires broker.message_format='v2'")
         if self.message_format not in ("v1", "v2"):
             raise ValueError(
                 f"broker.message_format must be v1|v2, got {self.message_format!r}")

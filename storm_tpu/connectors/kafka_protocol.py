@@ -236,11 +236,13 @@ def encode_record_batch(
     ts_ms: int,
     base_offset: int = 0,
     compression: Optional[str] = None,
+    producer: Optional[Tuple[int, int, int]] = None,
 ) -> bytes:
     """[(key, value)] -> one RecordBatch (magic 2; ``compression='gzip'``
     gzips the records block, attrs codec bit 1). CRC32C (Castagnoli)
     covers everything after the crc field, computed by the native layer
-    when built."""
+    when built. ``producer=(producer_id, epoch, base_sequence)`` stamps
+    the KIP-98 idempotence fields (default: -1/-1/-1, non-idempotent)."""
     from storm_tpu.native import crc32c
 
     if compression not in (None, "gzip"):
@@ -275,9 +277,10 @@ def encode_record_batch(
     after_crc.i32(len(records) - 1)  # lastOffsetDelta
     after_crc.i64(ts_ms)  # baseTimestamp
     after_crc.i64(ts_ms)  # maxTimestamp
-    after_crc.i64(-1)  # producerId
-    after_crc.i16(-1)  # producerEpoch
-    after_crc.i32(-1)  # baseSequence
+    pid, epoch, base_seq = producer if producer is not None else (-1, -1, -1)
+    after_crc.i64(pid)  # producerId
+    after_crc.i16(epoch)  # producerEpoch
+    after_crc.i32(base_seq)  # baseSequence
     after_crc.i32(len(records))
     after_crc.raw(payload)
     crc = crc32c(bytes(after_crc.buf))
@@ -557,22 +560,29 @@ class KafkaWireClient:
         timeout_ms: int = 30000,
         message_format: str = "v1",
         compression: Optional[str] = None,
+        producer: Optional[Tuple[int, int, int]] = None,
     ) -> int:
         """Returns the base offset assigned by the broker.
 
         ``message_format='v2'`` ships a KIP-98 RecordBatch over Produce v3
         (CRC32C, varint records; optional gzip) — what modern brokers store
         natively; 'v1' keeps the 0.11-era message set the reference ran
-        against."""
+        against. ``producer=(pid, epoch, base_seq)`` (v2 only) enables
+        idempotent produce: the broker dedups retried batches by sequence."""
         ts_ms = int(time.time() * 1e3)
         if message_format == "v2":
             payload = encode_record_batch(records, ts_ms,
-                                          compression=compression)
+                                          compression=compression,
+                                          producer=producer)
             api_version = 3
         elif message_format == "v1":
             if compression:
                 raise KafkaProtocolError(
                     "compression is only wired for message_format='v2'")
+            if producer is not None:
+                raise KafkaProtocolError(
+                    "idempotent produce needs message_format='v2' "
+                    "(KIP-98 RecordBatch carries the producer fields)")
             payload = encode_message_set(records, ts_ms)
             api_version = 2
         else:
@@ -641,6 +651,22 @@ class KafkaWireClient:
         return [rec for rec in out if rec.offset >= offset]
 
     # -- offsets --------------------------------------------------------------
+
+    def init_producer_id(self, timeout_ms: int = 30000) -> Tuple[int, int]:
+        """InitProducerId (api 22 v0, KIP-98): allocate a (producer_id,
+        epoch) for idempotent produce. Transactions are out of scope —
+        transactional_id is always null."""
+        w = Writer()
+        w.string(None)  # transactional_id
+        w.i32(timeout_ms)
+        r = self._request(self.bootstrap, 22, 0, bytes(w.buf))
+        r.i32()  # throttle
+        err = r.i16()
+        if err:
+            raise KafkaProtocolError(f"init_producer_id error code {err}")
+        pid = r.i64()
+        epoch = r.i16()
+        return pid, epoch
 
     def list_offset(self, topic: str, partition: int, timestamp: int) -> int:
         """timestamp -1 = log end, -2 = log start."""
@@ -940,10 +966,24 @@ class KafkaWireBroker:
 
     def __init__(self, bootstrap: str, client_id: str = "storm-tpu",
                  message_format: str = "v1",
-                 compression: Optional[str] = None) -> None:
+                 compression: Optional[str] = None,
+                 idempotent: bool = False) -> None:
         self.client = KafkaWireClient(bootstrap, client_id)
+        if idempotent and message_format != "v2":
+            raise KafkaProtocolError(
+                "idempotent=True requires message_format='v2'")
         self.message_format = message_format
         self.compression = compression
+        # KIP-98 idempotent produce: one (producer_id, epoch) per broker
+        # handle, lazily initialized; per-partition monotone sequences.
+        # A network-error retry of produce() resends the SAME sequence,
+        # which the broker recognizes and appends at most once — closing
+        # the duplicate window of the sink's retry path.
+        self.idempotent = idempotent
+        self._producer: Optional[Tuple[int, int]] = None
+        self._seqs: Dict[Tuple[str, int], int] = {}
+        self._pid_lock = threading.Lock()
+        self._part_locks: Dict[Tuple[str, int], threading.Lock] = {}
         self._rr = 0
         # Decoded-but-not-yet-returned tail of the last wire fetch, per
         # partition: a 1MB fetch can decode far more than max_records, and
@@ -970,10 +1010,57 @@ class KafkaWireBroker:
             else:
                 partition = self._rr % n
                 self._rr += 1
-        off = self.client.produce(topic, partition, [(key, value)],
-                                  message_format=self.message_format,
-                                  compression=self.compression)
-        return partition, off
+        if not self.idempotent:
+            off = self.client.produce(topic, partition, [(key, value)],
+                                      message_format=self.message_format,
+                                      compression=self.compression)
+            return partition, off
+        # The broker requires strictly ordered sequences per partition, so
+        # idempotent sends are serialized per partition: reserve + send +
+        # advance under one lock (concurrency buys nothing the broker
+        # would accept out of order). Network retries resend the SAME
+        # sequence — the broker appends at most once, so a timeout whose
+        # write actually landed does not duplicate. The sequence advances
+        # only after success; any final failure re-inits the producer id
+        # (fresh pid => sequences restart at 0, the real producer's
+        # epoch-bump dance) so the partition can never wedge out-of-order.
+        with self._pid_lock:
+            plock = self._part_locks.setdefault(
+                (topic, partition), threading.Lock())
+        with plock:
+            with self._pid_lock:
+                if self._producer is None:
+                    self._producer = self.client.init_producer_id()
+                pid, epoch = self._producer
+            # Sequences are valid only for the pid that reserved them: a
+            # concurrent failure-reset swaps the pid, and a stale entry
+            # must read as "start at 0", not leak the old chain.
+            spid, seq = self._seqs.get((topic, partition), (pid, 0))
+            if spid != pid:
+                seq = 0
+            last_err: Optional[Exception] = None
+            for attempt in range(3):
+                try:
+                    off = self.client.produce(
+                        topic, partition, [(key, value)],
+                        message_format=self.message_format,
+                        compression=self.compression,
+                        producer=(pid, epoch, seq))
+                    self._seqs[(topic, partition)] = (pid, seq + 1)
+                    return partition, off
+                except (OSError, ConnectionError) as e:
+                    last_err = e
+                    if attempt < 2:
+                        time.sleep(0.05 * 2 ** attempt)
+                except KafkaProtocolError as e:
+                    # Broker-rejected (not-leader, too-large, sequence
+                    # state lost...): same-sequence retry won't change the
+                    # verdict — reset the producer instead.
+                    last_err = e
+                    break
+            with self._pid_lock:
+                self._producer = None
+            raise last_err
 
     def fetch(self, topic, partition, offset, max_records=512):
         key = (topic, partition)

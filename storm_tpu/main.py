@@ -111,7 +111,8 @@ def _make_broker(cfg: Config):
         from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
 
         return KafkaWireBroker(cfg.broker.bootstrap,
-                               message_format=cfg.broker.message_format)
+                               message_format=cfg.broker.message_format,
+                               idempotent=cfg.broker.idempotent)
     raise ValueError(f"unknown broker kind {cfg.broker.kind!r}")
 
 

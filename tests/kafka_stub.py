@@ -41,6 +41,11 @@ class KafkaStubBroker:
         # "stable": set(member ids that joined the current generation)}
         self._groups: Dict[str, dict] = {}
         self._member_seq = 0
+        # KIP-98 idempotence: allocated producer ids and, per
+        # (pid, topic, partition), the last accepted (base_seq, count,
+        # base_offset) for duplicate/out-of-order detection.
+        self._next_pid = 1000
+        self._pid_state: Dict[Tuple[int, str, int], Tuple[int, int, int]] = {}
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -143,6 +148,8 @@ class KafkaStubBroker:
             return self._heartbeat(r)
         if api == 13:
             return self._leave_group(r)
+        if api == 22:
+            return self._init_producer_id(r)
         raise RuntimeError(f"stub does not implement api {api}")
 
     def _metadata(self, r: Reader) -> bytes:
@@ -165,6 +172,32 @@ class KafkaStubBroker:
                 w.i32(1).i32(0)  # isr
         return bytes(w.buf)
 
+    def _init_producer_id(self, r: Reader) -> bytes:
+        r.string()  # transactional_id (must be null — no txn support)
+        r.i32()  # timeout_ms
+        with self._lock:
+            pid = self._next_pid
+            self._next_pid += 1
+        w = Writer()
+        w.i32(0).i16(0).i64(pid).i16(0)  # throttle, err, pid, epoch
+        return bytes(w.buf)
+
+    @staticmethod
+    def _batch_producer_fields(data: bytes):
+        """(producer_id, base_sequence, record_count) of a magic-2 batch,
+        or None for v0/v1 message sets / non-idempotent batches."""
+        # baseOffset(8) len(4) leaderEpoch(4) magic(1) crc(4) attrs(2)
+        # lastOffsetDelta(4) baseTs(8) maxTs(8) pid(8) epoch(2) baseSeq(4)
+        # count(4)
+        if len(data) < 61 or data[16] != 2:
+            return None
+        prod_id, = struct.unpack(">q", data[43:51])
+        if prod_id < 0:
+            return None
+        base_seq, = struct.unpack(">i", data[53:57])
+        count, = struct.unpack(">i", data[57:61])
+        return prod_id, base_seq, count
+
     def _produce(self, r: Reader, version: int = 2) -> bytes:
         if version >= 3:
             r.string()  # transactional_id (KIP-98)
@@ -181,14 +214,31 @@ class KafkaStubBroker:
             for _ in range(n_parts):
                 pid = r.i32()
                 data = r.bytes_() or b""
-                records = decode_message_set(topic, pid, data)
+                prod = self._batch_producer_fields(data)
+                err = 0
                 with self._lock:
                     self._ensure(topic)
                     log = self._logs[(topic, pid)]
                     base = len(log)
-                    for rec in records:
-                        log.append((rec.key, rec.value, time.time()))
-                w.i32(pid).i16(0).i64(base).i64(-1)
+                    if prod is not None:
+                        prod_id, base_seq, count = prod
+                        key = (prod_id, topic, pid)
+                        last = self._pid_state.get(key)
+                        expected = 0 if last is None else last[0] + last[1]
+                        if last is not None and base_seq == last[0]:
+                            # exact duplicate of the last batch: already
+                            # appended; ack with the original base offset
+                            base = last[2]
+                            data = b""
+                        elif base_seq != expected:
+                            err = 45  # OUT_OF_ORDER_SEQUENCE_NUMBER
+                            data = b""
+                        else:
+                            self._pid_state[key] = (base_seq, count, base)
+                    if data:
+                        for rec in decode_message_set(topic, pid, data):
+                            log.append((rec.key, rec.value, time.time()))
+                w.i32(pid).i16(err).i64(base).i64(-1)
         w.i32(0)  # throttle
         return bytes(w.buf)
 

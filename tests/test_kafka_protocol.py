@@ -443,3 +443,65 @@ def test_group_dead_member_expires():
         assert sorted(a1) == [("t", 0), ("t", 1)]
     finally:
         stub.close()
+
+
+def test_idempotent_produce_dedups_retried_batch():
+    """KIP-98 idempotence: resending a batch with the same (pid, sequence)
+    appends at most once; a sequence gap errors OUT_OF_ORDER (45)."""
+    from storm_tpu.connectors.kafka_protocol import (
+        KafkaProtocolError, KafkaWireClient)
+
+    stub = KafkaStubBroker(partitions=1)
+    try:
+        c = KafkaWireClient(f"127.0.0.1:{stub.port}")
+        pid, epoch = c.init_producer_id()
+        assert pid >= 0 and epoch == 0
+        # two distinct producers get distinct ids
+        assert KafkaWireClient(f"127.0.0.1:{stub.port}").init_producer_id()[0] != pid
+
+        off0 = c.produce("t", 0, [(None, b"a")], message_format="v2",
+                         producer=(pid, epoch, 0))
+        # simulated retry: same sequence again -> no second append, same offset
+        off_dup = c.produce("t", 0, [(None, b"a")], message_format="v2",
+                            producer=(pid, epoch, 0))
+        assert off_dup == off0
+        assert stub.topic_size("t") == 1
+        # next sequence appends
+        c.produce("t", 0, [(None, b"b")], message_format="v2",
+                  producer=(pid, epoch, 1))
+        assert stub.topic_size("t") == 2
+        # gap -> out-of-order error
+        with pytest.raises(KafkaProtocolError, match="45"):
+            c.produce("t", 0, [(None, b"c")], message_format="v2",
+                      producer=(pid, epoch, 5))
+        assert stub.topic_size("t") == 2
+        c.close()
+    finally:
+        stub.close()
+
+
+def test_idempotent_broker_wrapper_sequences():
+    """KafkaWireBroker(idempotent=True) stamps monotone sequences per
+    partition and records survive a full produce/fetch round trip."""
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+    stub = KafkaStubBroker(partitions=2)
+    try:
+        b = KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2",
+                            idempotent=True)
+        parts = set()
+        for i in range(6):
+            p, off = b.produce("t", f"m{i}".encode(), partition=i % 2)
+            parts.add(p)
+        assert parts == {0, 1}
+        assert stub.topic_size("t") == 6
+        got = sorted(r.value.decode() for p in (0, 1)
+                     for r in b.fetch("t", p, 0))
+        assert got == [f"m{i}" for i in range(6)]
+        # config validation: idempotent requires v2
+        from storm_tpu.connectors.kafka_protocol import KafkaProtocolError
+        with pytest.raises(KafkaProtocolError, match="message_format"):
+            KafkaWireBroker(f"127.0.0.1:{stub.port}", idempotent=True)
+        b.close()
+    finally:
+        stub.close()
