@@ -1041,12 +1041,17 @@ class KafkaWireBroker:
             last_err: Optional[Exception] = None
             for attempt in range(3):
                 try:
+                    # acks=all: idempotence at acks=1 can lose an acked
+                    # sequenced batch on leader failover and then wedge
+                    # out-of-order — real producers force all() too.
                     off = self.client.produce(
-                        topic, partition, [(key, value)],
+                        topic, partition, [(key, value)], acks=-1,
                         message_format=self.message_format,
                         compression=self.compression,
                         producer=(pid, epoch, seq))
-                    self._seqs[(topic, partition)] = (pid, seq + 1)
+                    # int32 sequence wraps mod 2^31 like Kafka's producer.
+                    self._seqs[(topic, partition)] = (
+                        pid, (seq + 1) & 0x7FFFFFFF)
                     return partition, off
                 except (OSError, ConnectionError) as e:
                     last_err = e
