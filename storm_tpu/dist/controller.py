@@ -351,6 +351,21 @@ class DistCluster:
             self._swaps[component] = merged
         return resp.get("model", {})
 
+    def component_stats(self, component: str) -> list:
+        """Per-executor stats from the worker hosting ``component``."""
+        with self._lock:
+            w = self._placement.get(component)
+            if w is None:
+                raise KeyError(component)
+            client = self.clients[w]
+        try:
+            return client.control(
+                "component_stats", component=component)["executors"]
+        except RuntimeError as e:
+            if "KeyError" in str(e):
+                raise KeyError(component) from e
+            raise
+
     def seek(self, component: str, position) -> int:
         """Reposition a spout component on its hosting worker."""
         with self._lock:

@@ -154,6 +154,11 @@ class DistRuntimeView:
         return await asyncio.to_thread(
             self._dist.swap_model, component, overrides)
 
+    def component_stats(self, component: str) -> list:
+        # Called via asyncio.to_thread by the UI route, so the blocking
+        # worker RPC is already off-loop.
+        return self._dist.component_stats(component)
+
     async def seek(self, component: str, position) -> int:
         return await asyncio.to_thread(self._dist.seek, component, position)
 

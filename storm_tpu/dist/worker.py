@@ -467,6 +467,8 @@ class WorkerServer:
             else:
                 self._run_on_loop(self.rt.resize_remote_group(component, new))
             return {"ok": True, "previous": prev}
+        if cmd == "component_stats":
+            return {"executors": self.rt.component_stats(req["component"])}
         if cmd == "seek":
             n = self._run_on_loop(
                 self.rt.seek(req["component"], req["position"]))

@@ -243,6 +243,11 @@ def _ctl(args) -> int:
         rc, out = call("GET", f"/api/v1/topology/{topo}")
     elif cmd in ("metrics", "graph", "errors"):
         rc, out = call("GET", f"/api/v1/topology/{topo}/{cmd}")
+    elif cmd == "component":
+        import urllib.parse as _up
+
+        rc, out = call("GET", f"/api/v1/topology/{topo}/component/"
+                              f"{_up.quote(args.component, safe='')}")
     elif cmd in ("activate", "deactivate"):
         rc, out = call("POST", f"/api/v1/topology/{topo}/{cmd}")
     elif cmd == "drain":
@@ -389,6 +394,12 @@ def main(argv=None) -> int:
     c.add_argument("topology")
     c.add_argument("component")
     c.add_argument("parallelism", type=int)
+    c = ctlsub.add_parser(
+        "component",
+        help="per-executor stats table for one component (Storm UI's "
+             "executor rows)")
+    c.add_argument("topology")
+    c.add_argument("component")
     c = ctlsub.add_parser(
         "seek",
         help="reposition a spout's consumption: earliest|latest|<offset>|"

@@ -381,6 +381,36 @@ class TopologyRuntime:
             for e in pending:
                 await e.bolt.swap_model(new_cfg)
 
+    def component_stats(self, component_id: str) -> list:
+        """Per-executor stats for one component (Storm UI's executor
+        table): task index, executed/avg-latency for bolts, in-flight and
+        acked/failed trees for spouts."""
+        if component_id in self.bolt_execs:
+            return [
+                {
+                    "task": e.task_index,
+                    "executed": e.n_executed,
+                    "avg_execute_ms": round(
+                        e.exec_ms_total / e.n_executed, 3)
+                    if e.n_executed else None,
+                    "errors": e.n_errors,
+                    "inbox_depth": e.inbox.qsize(),
+                }
+                for e in self.bolt_execs[component_id]
+            ]
+        if component_id in self.spout_execs:
+            return [
+                {
+                    "task": e.task_index,
+                    "acked": e.n_acked,
+                    "failed": e.n_failed,
+                    "errors": e.n_errors,
+                    "inflight": e.inflight,
+                }
+                for e in self.spout_execs[component_id]
+            ]
+        raise KeyError(component_id)
+
     async def seek(self, component_id: str, position) -> int:
         """Reposition a spout component's consumption (replay/backfill).
         Returns the number of instances repositioned."""

@@ -47,6 +47,11 @@ class BoltExecutor:
             maxsize=inbox_capacity
         )
         self.tick_interval_s = tick_interval_s
+        # Per-executor stats (Storm UI's per-executor table): plain ints
+        # updated on the owning loop, read by the stats route.
+        self.n_executed = 0
+        self.exec_ms_total = 0.0
+        self.n_errors = 0
         self._task: Optional[asyncio.Task] = None
         self._tick_task: Optional[asyncio.Task] = None
         self._ckpt_task: Optional[asyncio.Task] = None
@@ -142,12 +147,20 @@ class BoltExecutor:
                     await self.bolt.tick()
                 else:
                     executed.inc()
+                    self.n_executed += 1
                     t0 = _time.perf_counter()
-                    await self.bolt.execute(t)
-                    exec_ms.observe((_time.perf_counter() - t0) * 1e3)
+                    try:
+                        await self.bolt.execute(t)
+                    finally:
+                        # Count time for failed executes too, or a failing
+                        # bolt reports a misleadingly low average.
+                        dt_ms = (_time.perf_counter() - t0) * 1e3
+                        exec_ms.observe(dt_ms)
+                        self.exec_ms_total += dt_ms
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # fail the tuple, keep the executor alive
+                self.n_errors += 1
                 self.rt.report_error(self.component_id, self.task_index, e)
                 if not is_tick(t):
                     self.collector.fail(t)
@@ -206,6 +219,10 @@ class SpoutExecutor:
         self.spout = spout
         self.max_pending = max_pending
         self.inflight = 0
+        # Per-executor stats (see BoltExecutor)
+        self.n_acked = 0
+        self.n_failed = 0
+        self.n_errors = 0
         self._slot = asyncio.Event()
         self._slot.set()
         self._task: Optional[asyncio.Task] = None
@@ -221,9 +238,11 @@ class SpoutExecutor:
         m = self.rt.metrics
         if ok:
             m.counter(self.component_id, "tree_acked").inc()
+            self.n_acked += 1
             self.spout.ack(msg_id)
         else:
             m.counter(self.component_id, "tree_failed").inc()
+            self.n_failed += 1
             self.spout.fail(msg_id)
 
     def track(self) -> None:
@@ -257,6 +276,7 @@ class SpoutExecutor:
             except asyncio.CancelledError:
                 raise
             except Exception as e:
+                self.n_errors += 1
                 self.rt.report_error(self.component_id, self.task_index, e)
                 emitted = False
             if not emitted:

@@ -15,6 +15,7 @@ Read routes
     GET /api/v1/topology/{name}/metrics       full metrics snapshot
     GET /api/v1/topology/{name}/errors        reported component errors
     GET /api/v1/topology/{name}/graph         the DAG (components + edges)
+    GET /api/v1/topology/{name}/component/{id}  per-executor stats table
     GET /api/v1/topology/{name}/logs          dist worker stderr tail
                                               (?worker=N&bytes=M)
     GET /metrics                              Prometheus text exposition
@@ -298,6 +299,18 @@ class UIServer:
                 except KeyError as e:
                     return 404, {"error": e.args[0] if e.args else str(e)}
                 return 200, {"worker": widx, "log": text}
+            if action.startswith("component/"):
+                # Per-executor stats table (Storm UI's executor rows).
+                if method != "GET":
+                    return 405, {"error": "use GET"}
+                from urllib.parse import unquote
+
+                cid = unquote(action[len("component/"):])
+                try:
+                    stats = await asyncio.to_thread(rt.component_stats, cid)
+                except KeyError:
+                    return 404, {"error": f"no component {cid!r}"}
+                return 200, {"component": cid, "executors": stats}
             if action == "graph":
                 if method != "GET":
                     return 405, {"error": "use GET"}

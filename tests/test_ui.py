@@ -492,3 +492,33 @@ def test_ui_seek_action(run):
             await cluster.shutdown()
 
     run(go(), timeout=60)
+
+
+def test_ui_component_stats(run):
+    """GET /component/{cid} returns per-executor rows with task-level
+    executed counts; unknown components 404."""
+
+    async def go():
+        cluster, ui = await _cluster_with_ui()
+        try:
+            await asyncio.sleep(0.3)
+            st, out = await _http(ui.port, "GET",
+                                  "/api/v1/topology/demo/component/echo")
+            assert st == 200 and out["component"] == "echo"
+            rows = out["executors"]
+            assert [r["task"] for r in rows] == [0, 1]
+            assert sum(r["executed"] for r in rows) > 0
+            assert all("avg_execute_ms" in r and "inbox_depth" in r
+                       for r in rows)
+            st, out = await _http(ui.port, "GET",
+                                  "/api/v1/topology/demo/component/spout")
+            assert st == 200
+            assert {"acked", "failed", "inflight"} <= set(out["executors"][0])
+            st, _ = await _http(ui.port, "GET",
+                                "/api/v1/topology/demo/component/zzz")
+            assert st == 404
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
