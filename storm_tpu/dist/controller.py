@@ -328,7 +328,7 @@ class DistCluster:
             # tasks the replacement doesn't have).
             self._rebalances[component] = parallelism
 
-    def swap_model(self, component: str, overrides: dict,
+    def swap_model(self, component: str, overrides: dict, tasks=None,
                    timeout: float = 600.0) -> dict:
         """Live model swap on the worker hosting ``component`` (components
         are placed whole, so exactly one worker owns its executors).
@@ -342,13 +342,21 @@ class DistCluster:
             if w is None:
                 raise KeyError(component)
             client = self.clients[w]
-        resp = client.control(
-            "swap_model", component=component, model=overrides,
-            timeout=timeout,
-        )
-        with self._lock:
-            merged = {**self._swaps.get(component, {}), **overrides}
-            self._swaps[component] = merged
+        try:
+            resp = client.control(
+                "swap_model", component=component, model=overrides,
+                tasks=tasks, timeout=timeout,
+            )
+        except RuntimeError as e:
+            if "KeyError" in str(e):
+                raise KeyError(str(e)) from e
+            raise
+        if tasks is None:
+            # Canary swaps are deliberately NOT recorded for recovery
+            # replay: a replaced worker restarts on the majority model.
+            with self._lock:
+                merged = {**self._swaps.get(component, {}), **overrides}
+                self._swaps[component] = merged
         return resp.get("model", {})
 
     def component_stats(self, component: str) -> list:

@@ -150,9 +150,10 @@ class DistRuntimeView:
     async def rebalance(self, component: str, parallelism: int) -> None:
         await asyncio.to_thread(self._dist.rebalance, component, parallelism)
 
-    async def swap_model(self, component: str, overrides: dict) -> dict:
+    async def swap_model(self, component: str, overrides: dict,
+                         tasks=None) -> dict:
         return await asyncio.to_thread(
-            self._dist.swap_model, component, overrides)
+            self._dist.swap_model, component, overrides, tasks)
 
     def component_stats(self, component: str) -> list:
         # Called via asyncio.to_thread by the UI route, so the blocking

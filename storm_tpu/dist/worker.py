@@ -503,7 +503,8 @@ class WorkerServer:
             # Engine build+warmup can far exceed the default control
             # timeout; match the controller's 600s budget.
             new_cfg = self._run_on_loop(
-                self.rt.swap_model(req["component"], req["model"]),
+                self.rt.swap_model(req["component"], req["model"],
+                                   tasks=req.get("tasks")),
                 timeout=600.0,
             )
             return {"ok": True, "model": _dc.asdict(new_cfg)}

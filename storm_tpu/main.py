@@ -288,9 +288,11 @@ def _ctl(args) -> int:
             except ValueError:
                 overrides[k] = v  # bare string (checkpoint paths etc.)
         # Engine warmup happens inside this call; give it compile time.
+        body = {"component": args.component, "model": overrides}
+        if args.task:
+            body["tasks"] = args.task
         rc, out = call("POST", f"/api/v1/topology/{topo}/swap_model",
-                       {"component": args.component, "model": overrides},
-                       timeout=600)
+                       body, timeout=600)
     elif cmd == "logs":
         rc, out = call(
             "GET",
@@ -425,6 +427,11 @@ def main(argv=None) -> int:
     c.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                    help="ModelConfig field override, repeatable "
                         "(e.g. --set checkpoint=/models/v2)")
+    c.add_argument("--task", action="append", type=int, default=[],
+                   metavar="N",
+                   help="canary: swap only these task indexes (repeatable); "
+                        "compare with `ctl component`, then swap the rest "
+                        "or roll back")
     c = ctlsub.add_parser("logs")
     c.add_argument("topology")
     c.add_argument("--worker", type=int, default=0)

@@ -347,11 +347,19 @@ class TopologyRuntime:
 
     # ---- elasticity ----------------------------------------------------------
 
-    async def swap_model(self, component_id: str, overrides: dict):
+    async def swap_model(self, component_id: str, overrides: dict,
+                         tasks: Optional[list] = None):
         """Live model swap on an inference component: apply field
         ``overrides`` (e.g. ``{"checkpoint": "/models/v2"}``) to its
         current ModelConfig and roll every instance onto the new engine
-        under traffic. Returns the new config."""
+        under traffic. Returns the new config.
+
+        ``tasks=[i, ...]`` swaps only those instances — a canary: compare
+        the canary tasks' `component_stats` rows (avg_execute_ms, errors,
+        and the per-task ``model`` descriptor) against the rest, then
+        swap the remainder or roll the canary back. Canary swaps leave
+        the prototype untouched, so rebalance-added executors keep the
+        majority model."""
         import dataclasses as _dc
 
         execs = self.bolt_execs.get(component_id)
@@ -360,11 +368,28 @@ class TopologyRuntime:
         swappable = [e for e in execs if hasattr(e.bolt, "swap_model")]
         if not swappable:
             raise TypeError(f"component {component_id!r} has no model to swap")
-        new_cfg = _dc.replace(swappable[0].bolt.model_cfg, **overrides)
+        # Base on the PROTOTYPE config, not a live instance: after a canary,
+        # instance configs diverge, and deriving from the canaried task
+        # would silently promote its fields into every later swap.
+        proto = self.topology.specs[component_id].obj
+        base = proto.model_cfg if hasattr(proto, "model_cfg") \
+            else swappable[0].bolt.model_cfg
+        new_cfg = _dc.replace(base, **overrides)
+        if tasks is not None:
+            if not tasks:
+                raise ValueError("tasks must be a non-empty list")
+            chosen = [e for e in swappable if e.task_index in set(tasks)]
+            missing = set(tasks) - {e.task_index for e in chosen}
+            if missing:
+                raise KeyError(
+                    f"no swappable task(s) {sorted(missing)} in "
+                    f"{component_id!r}")
+            for e in chosen:
+                await e.bolt.swap_model(new_cfg)
+            return new_cfg
         # Update the prototype FIRST: executors cloned by a rebalance that
         # interleaves with the (slow, awaiting) engine builds below must
         # pick up the new model, not the submit-time one.
-        proto = self.topology.specs[component_id].obj
         if hasattr(proto, "model_cfg"):
             proto.model_cfg = new_cfg
         # First call builds+warms the engine (shared per process); the rest
@@ -386,6 +411,20 @@ class TopologyRuntime:
         table): task index, executed/avg-latency for bolts, in-flight and
         acked/failed trees for spouts."""
         if component_id in self.bolt_execs:
+            def model_of(e):
+                cfg = getattr(e.bolt, "model_cfg", None)
+                if cfg is None:
+                    return None
+                # Compact version descriptor for canary comparison.
+                parts = [cfg.name]
+                if cfg.checkpoint:
+                    parts.append(cfg.checkpoint)
+                if cfg.seed:
+                    parts.append(f"seed={cfg.seed}")
+                if getattr(cfg, "weights", "float") != "float":
+                    parts.append(cfg.weights)
+                return ":".join(parts)
+
             return [
                 {
                     "task": e.task_index,
@@ -395,6 +434,7 @@ class TopologyRuntime:
                     if e.n_executed else None,
                     "errors": e.n_errors,
                     "inbox_depth": e.inbox.qsize(),
+                    **({"model": m} if (m := model_of(e)) else {}),
                 }
                 for e in self.bolt_execs[component_id]
             ]

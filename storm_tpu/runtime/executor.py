@@ -139,6 +139,7 @@ class BoltExecutor:
                 try:
                     self._checkpoint()
                 except Exception as e:
+                    self.n_errors += 1
                     self.rt.report_error(self.component_id, self.task_index, e)
                 continue
             t: Tuple = item

@@ -500,13 +500,21 @@ class UIServer:
         if action == "swap_model":
             component = args.get("component")
             overrides = args.get("model")
+            tasks = args.get("tasks")
             if not component or not isinstance(overrides, dict) or not overrides:
                 return 400, {"error": "need component and a non-empty "
                                       "model overrides object"}
+            if tasks is not None and (
+                    not isinstance(tasks, list)
+                    or not all(isinstance(t, int) for t in tasks)
+                    or not tasks):
+                return 400, {"error": "tasks must be a non-empty int list"}
             try:
-                new_cfg = await rt.swap_model(component, overrides)
-            except KeyError:
-                return 404, {"error": f"no component {component!r}"}
+                new_cfg = await rt.swap_model(component, overrides,
+                                              tasks=tasks)
+            except KeyError as e:
+                return 404, {"error": e.args[0] if e.args
+                             else f"no component {component!r}"}
             except TypeError as e:
                 return 400, {"error": str(e)}
             except ValueError as e:
@@ -514,7 +522,8 @@ class UIServer:
             import dataclasses as _dc
 
             model = _dc.asdict(new_cfg) if _dc.is_dataclass(new_cfg) else new_cfg
-            return 200, {"component": component, "model": model}
+            return 200, {"component": component, "model": model,
+                         **({"tasks": tasks} if tasks is not None else {})}
         if action == "rebalance":
             component = args.get("component")
             try:

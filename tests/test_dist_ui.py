@@ -89,6 +89,13 @@ def test_dist_ui_status_and_admin(run):
                         await asyncio.sleep(0.3)
                     assert met["inference-bolt"]["instances_inferred"] >= 6
 
+                    # per-executor stats route through the hosting worker
+                    st, comp = await loop.run_in_executor(
+                        None, _http, ui.port,
+                        "GET", "/api/v1/topology/dist-ui/component/inference-bolt")
+                    assert st == 200
+                    assert sum(r["executed"] for r in comp["executors"]) >= 6
+
                     # live rebalance over HTTP reaches the workers
                     st, _ = await loop.run_in_executor(
                         None, _http, ui.port, "POST",

@@ -478,3 +478,47 @@ def test_eager_dispatch_low_latency_and_batching_under_load(run):
         await cluster.shutdown()
 
     run(go(), timeout=120)
+
+
+def test_canary_swap_single_task(run):
+    """swap_model(tasks=[0]) rolls one instance only; component_stats shows
+    the mixed model versions; a follow-up full swap converges everyone."""
+    from storm_tpu.config import BatchConfig, Config, ModelConfig
+    from storm_tpu.connectors import BrokerSpout, MemoryBroker
+    from storm_tpu.infer import InferenceBolt
+    from storm_tpu.runtime import TopologyBuilder
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    async def go():
+        broker = MemoryBroker()
+        tb = TopologyBuilder()
+        tb.set_spout("s", BrokerSpout(broker, "in"), 1)
+        tb.set_bolt("infer", InferenceBolt(
+            ModelConfig(name="lenet5", input_shape=(28, 28, 1),
+                        dtype="float32", seed=0),
+            BatchConfig(max_batch=4, max_wait_ms=10, buckets=(4,))),
+            2).shuffle_grouping("s")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("canary", Config(), tb.build())
+
+        new_cfg = await rt.swap_model("infer", {"seed": 7}, tasks=[0])
+        assert new_cfg.seed == 7
+        seeds = {e.task_index: e.bolt.model_cfg.seed
+                 for e in rt.bolt_execs["infer"]}
+        assert seeds == {0: 7, 1: 0}
+        # prototype unchanged: rebalance-added executors keep the majority
+        assert rt.topology.specs["infer"].obj.model_cfg.seed == 0
+        rows = rt.component_stats("infer")
+        models = {r["task"]: r["model"] for r in rows}
+        assert models[0] != models[1] and "seed=7" in models[0]
+        # unknown task errors
+        with pytest.raises(KeyError):
+            await rt.swap_model("infer", {"seed": 9}, tasks=[5])
+        # full swap converges
+        await rt.swap_model("infer", {"seed": 7})
+        seeds = {e.task_index: e.bolt.model_cfg.seed
+                 for e in rt.bolt_execs["infer"]}
+        assert set(seeds.values()) == {7}
+        await cluster.shutdown()
+
+    run(go(), timeout=120)
