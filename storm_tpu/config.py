@@ -155,11 +155,16 @@ class SinkConfig:
     KafkaBolt (async-with-callback / sync / fire-and-forget,
     KafkaBolt.java:129-155)."""
 
-    mode: str = "async"  # 'async' | 'sync' | 'fire_and_forget'
+    mode: str = "async"  # 'async' | 'sync' | 'fire_and_forget' | 'transactional'
     acks: int = 1  # mirrors acks=1 (MainTopology.java:113)
+    # mode='transactional' (exactly-once egress, KIP-98): tuples buffer
+    # into one transaction per micro-batch and ack only after commit.
+    txn_batch: int = 64
+    txn_ms: float = 100.0
 
     def __post_init__(self) -> None:
-        if self.mode not in ("async", "sync", "fire_and_forget"):
+        if self.mode not in ("async", "sync", "fire_and_forget",
+                             "transactional"):
             raise ValueError(f"unknown sink mode {self.mode!r}")
 
 

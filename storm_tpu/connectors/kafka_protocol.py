@@ -237,6 +237,7 @@ def encode_record_batch(
     base_offset: int = 0,
     compression: Optional[str] = None,
     producer: Optional[Tuple[int, int, int]] = None,
+    transactional: bool = False,
 ) -> bytes:
     """[(key, value)] -> one RecordBatch (magic 2; ``compression='gzip'``
     gzips the records block, attrs codec bit 1). CRC32C (Castagnoli)
@@ -266,12 +267,12 @@ def encode_record_batch(
         body += rec
 
     payload = bytes(body)
-    attrs = 0
+    attrs = 0x10 if transactional else 0  # bit 4: isTransactional (KIP-98)
     if compression == "gzip":
         import gzip as _gzip
 
         payload = _gzip.compress(payload)
-        attrs = 1  # codec bits: gzip
+        attrs |= 1  # codec bits: gzip
     after_crc = Writer()
     after_crc.i16(attrs)
     after_crc.i32(len(records) - 1)  # lastOffsetDelta
@@ -561,6 +562,7 @@ class KafkaWireClient:
         message_format: str = "v1",
         compression: Optional[str] = None,
         producer: Optional[Tuple[int, int, int]] = None,
+        transactional_id: Optional[str] = None,
     ) -> int:
         """Returns the base offset assigned by the broker.
 
@@ -573,7 +575,9 @@ class KafkaWireClient:
         if message_format == "v2":
             payload = encode_record_batch(records, ts_ms,
                                           compression=compression,
-                                          producer=producer)
+                                          producer=producer,
+                                          transactional=transactional_id
+                                          is not None)
             api_version = 3
         elif message_format == "v1":
             if compression:
@@ -590,7 +594,10 @@ class KafkaWireClient:
                 f"message_format must be v1|v2, got {message_format!r}")
         w = Writer()
         if api_version >= 3:
-            w.string(None)  # transactional_id
+            w.string(transactional_id)
+        elif transactional_id is not None:
+            raise KafkaProtocolError(
+                "transactions need message_format='v2' (Produce v3)")
         w.i16(acks).i32(timeout_ms)
         w.i32(1)
         w.string(topic)
@@ -652,14 +659,20 @@ class KafkaWireClient:
 
     # -- offsets --------------------------------------------------------------
 
-    def init_producer_id(self, timeout_ms: int = 30000) -> Tuple[int, int]:
+    def init_producer_id(self, timeout_ms: int = 30000,
+                         transactional_id: Optional[str] = None,
+                         ) -> Tuple[int, int]:
         """InitProducerId (api 22 v0, KIP-98): allocate a (producer_id,
-        epoch) for idempotent produce. Transactions are out of scope —
-        transactional_id is always null."""
+        epoch). With ``transactional_id``, re-initializing the same id
+        bumps the epoch — fencing any zombie producer still using the old
+        one (its sends fail with INVALID_PRODUCER_EPOCH)."""
         w = Writer()
-        w.string(None)  # transactional_id
+        w.string(transactional_id)
         w.i32(timeout_ms)
-        r = self._request(self.bootstrap, 22, 0, bytes(w.buf))
+        if transactional_id is None:
+            r = self._request(self.bootstrap, 22, 0, bytes(w.buf))
+        else:
+            r = self._txn_request(transactional_id, 22, 0, bytes(w.buf))
         r.i32()  # throttle
         err = r.i16()
         if err:
@@ -667,6 +680,43 @@ class KafkaWireClient:
         pid = r.i64()
         epoch = r.i16()
         return pid, epoch
+
+    def add_partitions_to_txn(self, txn_id: str, pid: int, epoch: int,
+                              parts: List[Tuple[str, int]]) -> None:
+        """AddPartitionsToTxn (api 24 v0): register partitions with the
+        transaction before producing to them."""
+        w = Writer()
+        w.string(txn_id).i64(pid).i16(epoch)
+        by_topic: Dict[str, List[int]] = {}
+        for t, p in parts:
+            by_topic.setdefault(t, []).append(p)
+        w.i32(len(by_topic))
+        for t, ps in by_topic.items():
+            w.string(t)
+            w.i32(len(ps))
+            for p in ps:
+                w.i32(p)
+        r = self._txn_request(txn_id, 24, 0, bytes(w.buf))
+        r.i32()  # throttle
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                err = r.i16()
+                if err:
+                    raise KafkaProtocolError(
+                        f"add_partitions_to_txn error code {err}")
+
+    def end_txn(self, txn_id: str, pid: int, epoch: int,
+                commit: bool) -> None:
+        """EndTxn (api 26 v0): commit or abort the open transaction."""
+        w = Writer()
+        w.string(txn_id).i64(pid).i16(epoch).i8(1 if commit else 0)
+        r = self._txn_request(txn_id, 26, 0, bytes(w.buf))
+        r.i32()  # throttle
+        err = r.i16()
+        if err:
+            raise KafkaProtocolError(f"end_txn error code {err}")
 
     def list_offset(self, topic: str, partition: int, timestamp: int) -> int:
         """timestamp -1 = log end, -2 = log start."""
@@ -710,6 +760,41 @@ class KafkaWireClient:
         with self._lock:
             self._coordinators[group] = (host, port)
         return (host, port)
+
+    def _txn_coordinator_addr(self, txn_id: str) -> Tuple[str, int]:
+        """Transaction-coordinator lookup (FindCoordinator v1 with
+        coordinator_type=1), cached per transactional id."""
+        key = ("txn", txn_id)
+        with self._lock:
+            cached = self._coordinators.get(key)
+        if cached is not None:
+            return cached
+        w = Writer()
+        w.string(txn_id)
+        w.i8(1)  # coordinator_type: transaction
+        r = self._request(self.bootstrap, 10, 1, bytes(w.buf))
+        r.i32()  # throttle (v1)
+        err = r.i16()
+        r.string()  # error_message (v1)
+        r.i32()  # node id
+        host = r.string()
+        port = r.i32()
+        if err:
+            raise KafkaProtocolError(f"find_coordinator(txn) error code {err}")
+        with self._lock:
+            self._coordinators[key] = (host, port)
+        return (host, port)
+
+    def _txn_request(self, txn_id: str, api: int, version: int,
+                     body: bytes) -> Reader:
+        try:
+            return self._request(
+                self._txn_coordinator_addr(txn_id), api, version, body)
+        except (OSError, KafkaProtocolError):
+            with self._lock:
+                self._coordinators.pop(("txn", txn_id), None)
+            return self._request(
+                self._txn_coordinator_addr(txn_id), api, version, body)
 
     def _coordinator_request(
         self, group: str, api: int, version: int, body: bytes
@@ -995,21 +1080,25 @@ class KafkaWireBroker:
     def partitions_for(self, topic: str) -> int:
         return self.client.partitions_for(topic)
 
+    def _select_partition(self, topic, key, partition):
+        """Shared partitioner: explicit > stable key hash > round robin.
+        (Python's hash() is seed-randomized per run; a durable Kafka log
+        outlives the seed, so keyed ordering uses crc32.)"""
+        if partition is not None:
+            return partition
+        n = self.partitions_for(topic)
+        if key is not None:
+            return zlib.crc32(key) % n
+        p = self._rr % n
+        self._rr += 1
+        return p
+
     def produce(self, topic, value, key=None, partition=None):
         if isinstance(value, str):
             value = value.encode("utf-8")
         if isinstance(key, str):
             key = key.encode("utf-8")
-        n = self.partitions_for(topic)
-        if partition is None:
-            if key is not None:
-                # Stable across processes (Python's hash() is seed-randomized
-                # per run; a durable Kafka log outlives the seed, so keyed
-                # ordering must use a deterministic hash).
-                partition = zlib.crc32(key) % n
-            else:
-                partition = self._rr % n
-                self._rr += 1
+        partition = self._select_partition(topic, key, partition)
         if not self.idempotent:
             off = self.client.produce(topic, partition, [(key, value)],
                                       message_format=self.message_format,
@@ -1085,6 +1174,11 @@ class KafkaWireBroker:
     def latest_offset(self, topic, partition):
         return self.client.list_offset(topic, partition, -1)
 
+    def txn(self, txn_id: str) -> "KafkaTxn":
+        """A transaction handle bound to ``txn_id`` (KIP-98 exactly-once
+        egress; see :class:`KafkaTxn`)."""
+        return KafkaTxn(self, txn_id)
+
     def commit(self, group, topic, partition, offset):
         self.client.offset_commit(group, topic, partition, offset)
 
@@ -1093,3 +1187,80 @@ class KafkaWireBroker:
 
     def close(self) -> None:
         self.client.close()
+
+
+class KafkaTxn:
+    """One Kafka transaction bound to a ``transactional_id`` (KIP-98).
+
+    Usage (the TransactionalSink's loop)::
+
+        txn = broker.txn("sink-topo-kafka-bolt-0")   # once per task
+        txn.begin(); txn.produce(...); ...; txn.commit()   # per batch
+
+    ``produce`` only buffers locally; ``commit`` registers partitions,
+    ships ONE sequenced RecordBatch per partition, and ends the
+    transaction — wire cost is O(partitions), not O(records). ``begin``
+    lazily (re)initializes the producer id for the transactional id;
+    re-initialization bumps the epoch, fencing any zombie task still
+    holding the old one. All control RPCs route via the transaction
+    coordinator (FindCoordinator type=1)."""
+
+    def __init__(self, broker: "KafkaWireBroker", txn_id: str) -> None:
+        self._broker = broker
+        self._client = broker.client
+        self.txn_id = txn_id
+        self._pid: Optional[int] = None
+        self._epoch = -1
+        self._seqs: Dict[Tuple[str, int], int] = {}
+        self._pending: Dict[Tuple[str, int], List[Tuple[Optional[bytes], bytes]]] = {}
+        self._open = False
+
+    def begin(self) -> None:
+        if self._pid is None:
+            self._pid, self._epoch = self._client.init_producer_id(
+                transactional_id=self.txn_id)
+            self._seqs.clear()
+        self._pending.clear()
+        self._open = True
+
+    def produce(self, topic: str, value, key=None, partition=None) -> None:
+        assert self._open, "begin() first"
+        if isinstance(value, str):
+            value = value.encode("utf-8")
+        if isinstance(key, str):
+            key = key.encode("utf-8")
+        partition = self._broker._select_partition(topic, key, partition)
+        self._pending.setdefault((topic, partition), []).append((key, value))
+
+    def commit(self) -> None:
+        self._end(True)
+
+    def abort(self) -> None:
+        self._end(False)
+
+    def _end(self, commit: bool) -> None:
+        if not self._open:
+            # abort() after a failed commit(): the transaction is already
+            # closed (and possibly fenced) — nothing further to send.
+            return
+        self._open = False
+        pending, self._pending = self._pending, {}
+        try:
+            if commit and pending:
+                self._client.add_partitions_to_txn(
+                    self.txn_id, self._pid, self._epoch, list(pending))
+                for (topic, partition), records in pending.items():
+                    seq = self._seqs.get((topic, partition), 0)
+                    self._client.produce(
+                        topic, partition, records, acks=-1,
+                        message_format="v2",
+                        producer=(self._pid, self._epoch, seq),
+                        transactional_id=self.txn_id)
+                    self._seqs[(topic, partition)] = \
+                        (seq + len(records)) & 0x7FFFFFFF
+            self._client.end_txn(self.txn_id, self._pid, self._epoch, commit)
+        except KafkaProtocolError:
+            # Fenced / coordinator lost the txn: force a fresh epoch on the
+            # next begin() rather than wedging this id.
+            self._pid = None
+            raise

@@ -74,23 +74,31 @@ class MemoryBroker:
         Partitioning mirrors Kafka's default: hash of key when present,
         round-robin otherwise.
         """
+        with self._lock:
+            return self._produce_locked(topic, value, key, partition)
+
+    def _produce_locked(self, topic, value, key=None, partition=None):
         if isinstance(value, str):
             value = value.encode("utf-8")
         if isinstance(key, str):
             key = key.encode("utf-8")
-        with self._lock:
-            self._ensure(topic)
-            n = self._partitions[topic]
-            if partition is None:
-                if key is not None:
-                    partition = hash(key) % n
-                else:
-                    partition = self._rr[topic] % n
-                    self._rr[topic] += 1
-            log = self._logs[(topic, partition)]
-            rec = Record(topic, partition, len(log), key, value, time.time())
-            log.append(rec)
-            return partition, rec.offset
+        self._ensure(topic)
+        n = self._partitions[topic]
+        if partition is None:
+            if key is not None:
+                partition = hash(key) % n
+            else:
+                partition = self._rr[topic] % n
+                self._rr[topic] += 1
+        log = self._logs[(topic, partition)]
+        rec = Record(topic, partition, len(log), key, value, time.time())
+        log.append(rec)
+        return partition, rec.offset
+
+    def txn(self, txn_id: str) -> "MemoryTxn":
+        """A transaction handle (buffer + atomic commit); same surface as
+        ``KafkaWireBroker.txn``."""
+        return MemoryTxn(self, txn_id)
 
     # ---- fetching ------------------------------------------------------------
 
@@ -148,3 +156,37 @@ class MemoryBroker:
             return sum(
                 len(self._logs[(topic, p)]) for p in range(self._partitions[topic])
             )
+
+
+class MemoryTxn:
+    """Transaction handle over :class:`MemoryBroker`: produced records
+    buffer locally and append atomically (under the broker lock) at
+    commit — read-committed visibility, same surface as the Kafka-backed
+    ``KafkaWireBroker.txn``. Abort drops the buffer."""
+
+    def __init__(self, broker: "MemoryBroker", txn_id: str) -> None:
+        self._broker = broker
+        self.txn_id = txn_id
+        self._pending: List[tuple] = []
+        self._open = False
+
+    def begin(self) -> None:
+        self._pending.clear()
+        self._open = True
+
+    def produce(self, topic: str, value, key=None, partition=None) -> None:
+        assert self._open, "begin() first"
+        self._pending.append((topic, value, key, partition))
+
+    def commit(self) -> None:
+        assert self._open, "begin() first"
+        self._open = False
+        with self._broker._lock:
+            # all-or-nothing under the broker lock: no fetch interleaves
+            for topic, value, key, partition in self._pending:
+                self._broker._produce_locked(topic, value, key, partition)
+        self._pending.clear()
+
+    def abort(self) -> None:
+        self._open = False
+        self._pending.clear()

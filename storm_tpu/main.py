@@ -30,9 +30,17 @@ from storm_tpu.config import Config
 from storm_tpu.utils.logging import setup_logging
 
 
+def _make_sink(cfg: Config, broker, topic):
+    from storm_tpu.connectors import BrokerSink, TransactionalSink
+
+    if cfg.sink.mode == "transactional":
+        return TransactionalSink(broker, topic, cfg.sink)
+    return BrokerSink(broker, topic, cfg.sink)
+
+
 def build_standard_topology(cfg: Config, broker):
     """The reference DAG (MainTopology.java:59-63) under our runtime."""
-    from storm_tpu.connectors import BrokerSink, BrokerSpout
+    from storm_tpu.connectors import BrokerSpout
     from storm_tpu.infer import InferenceBolt
     from storm_tpu.runtime import TopologyBuilder
 
@@ -49,12 +57,12 @@ def build_standard_topology(cfg: Config, broker):
     ).shuffle_grouping("kafka-spout")
     tb.set_bolt(
         "kafka-bolt",
-        BrokerSink(broker, cfg.broker.output_topic, cfg.sink),
+        _make_sink(cfg, broker, cfg.broker.output_topic),
         parallelism=cfg.topology.sink_parallelism,
     ).shuffle_grouping("inference-bolt")
     tb.set_bolt(
         "dlq-bolt",
-        BrokerSink(broker, cfg.broker.dead_letter_topic, cfg.sink),
+        _make_sink(cfg, broker, cfg.broker.dead_letter_topic),
         parallelism=1,
     ).shuffle_grouping("inference-bolt", stream="dead_letter")
     return tb.build()

@@ -46,6 +46,12 @@ class KafkaStubBroker:
         # base_offset) for duplicate/out-of-order detection.
         self._next_pid = 1000
         self._pid_state: Dict[Tuple[int, str, int], Tuple[int, int, int]] = {}
+        # Transactions (KIP-98): txn_id -> {"pid", "epoch", "pending":
+        # [(topic, part, key, value)], "parts": set}. Produced transactional
+        # batches buffer in "pending" and append at EndTxn(commit) — i.e.
+        # read-committed visibility; abort drops them. Re-InitProducerId on
+        # the same txn_id bumps the epoch (zombie fencing).
+        self._txns: Dict[str, dict] = {}
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -135,7 +141,7 @@ class KafkaStubBroker:
         if api == 2:
             return self._list_offsets(r)
         if api == 10:
-            return self._find_coordinator(r)
+            return self._find_coordinator(r, version)
         if api == 8:
             return self._offset_commit(r)
         if api == 9:
@@ -150,6 +156,10 @@ class KafkaStubBroker:
             return self._leave_group(r)
         if api == 22:
             return self._init_producer_id(r)
+        if api == 24:
+            return self._add_partitions_to_txn(r)
+        if api == 26:
+            return self._end_txn(r)
         raise RuntimeError(f"stub does not implement api {api}")
 
     def _metadata(self, r: Reader) -> bytes:
@@ -173,13 +183,82 @@ class KafkaStubBroker:
         return bytes(w.buf)
 
     def _init_producer_id(self, r: Reader) -> bytes:
-        r.string()  # transactional_id (must be null — no txn support)
+        txn_id = r.string()
         r.i32()  # timeout_ms
         with self._lock:
-            pid = self._next_pid
-            self._next_pid += 1
+            if txn_id is None:
+                pid, epoch = self._next_pid, 0
+                self._next_pid += 1
+            else:
+                st = self._txns.get(txn_id)
+                if st is None:
+                    st = {"pid": self._next_pid, "epoch": 0,
+                          "pending": [], "parts": set()}
+                    self._next_pid += 1
+                    self._txns[txn_id] = st
+                else:
+                    # fencing: bump epoch, drop any half-open transaction
+                    st["epoch"] += 1
+                    st["pending"] = []
+                    st["parts"] = set()
+                pid, epoch = st["pid"], st["epoch"]
         w = Writer()
-        w.i32(0).i16(0).i64(pid).i16(0)  # throttle, err, pid, epoch
+        w.i32(0).i16(0).i64(pid).i16(epoch)  # throttle, err, pid, epoch
+        return bytes(w.buf)
+
+    def _txn_check(self, txn_id, pid, epoch):
+        """error code for a txn RPC: 48 INVALID_TXN_STATE if unknown,
+        47 INVALID_PRODUCER_EPOCH if fenced."""
+        st = self._txns.get(txn_id)
+        if st is None or st["pid"] != pid:
+            return None, 48
+        if st["epoch"] != epoch:
+            return None, 47
+        return st, 0
+
+    def _add_partitions_to_txn(self, r: Reader) -> bytes:
+        txn_id = r.string()
+        pid = r.i64()
+        epoch = r.i16()
+        topics = []
+        for _ in range(r.i32()):
+            t = r.string()
+            for _ in range(r.i32()):
+                topics.append((t, r.i32()))
+        w = Writer()
+        w.i32(0)  # throttle
+        with self._lock:
+            st, err = self._txn_check(txn_id, pid, epoch)
+            if not err:
+                st["parts"].update(topics)
+        by_topic: Dict[str, List[int]] = {}
+        for t, p in topics:
+            by_topic.setdefault(t, []).append(p)
+        w.i32(len(by_topic))
+        for t, ps in by_topic.items():
+            w.string(t)
+            w.i32(len(ps))
+            for p in ps:
+                w.i32(p).i16(err)
+        return bytes(w.buf)
+
+    def _end_txn(self, r: Reader) -> bytes:
+        txn_id = r.string()
+        pid = r.i64()
+        epoch = r.i16()
+        commit = bool(r.i8())
+        with self._lock:
+            st, err = self._txn_check(txn_id, pid, epoch)
+            if not err:
+                if commit:
+                    for topic, part, key, value in st["pending"]:
+                        self._ensure(topic)
+                        self._logs[(topic, part)].append(
+                            (key, value, time.time()))
+                st["pending"] = []
+                st["parts"] = set()
+        w = Writer()
+        w.i32(0).i16(err)
         return bytes(w.buf)
 
     @staticmethod
@@ -194,13 +273,13 @@ class KafkaStubBroker:
         prod_id, = struct.unpack(">q", data[43:51])
         if prod_id < 0:
             return None
+        epoch, = struct.unpack(">h", data[51:53])
         base_seq, = struct.unpack(">i", data[53:57])
         count, = struct.unpack(">i", data[57:61])
-        return prod_id, base_seq, count
+        return prod_id, base_seq, count, epoch
 
     def _produce(self, r: Reader, version: int = 2) -> bytes:
-        if version >= 3:
-            r.string()  # transactional_id (KIP-98)
+        txn_id = r.string() if version >= 3 else None
         r.i16()  # acks
         r.i32()  # timeout
         w = Writer()
@@ -220,8 +299,24 @@ class KafkaStubBroker:
                     self._ensure(topic)
                     log = self._logs[(topic, pid)]
                     base = len(log)
-                    if prod is not None:
-                        prod_id, base_seq, count = prod
+                    if txn_id is not None:
+                        # transactional: buffer until EndTxn(commit)
+                        st = self._txns.get(txn_id)
+                        p_pid, _, _, p_epoch = prod if prod else (
+                            -1, -1, -1, -1)
+                        if st is None or st["pid"] != p_pid:
+                            err = 48  # INVALID_TXN_STATE
+                        elif st["epoch"] != p_epoch:
+                            err = 47  # INVALID_PRODUCER_EPOCH (fenced)
+                        elif (topic, pid) not in st["parts"]:
+                            err = 48  # partition not added to the txn
+                        else:
+                            for rec in decode_message_set(topic, pid, data):
+                                st["pending"].append(
+                                    (topic, pid, rec.key, rec.value))
+                        data = b""
+                    elif prod is not None:
+                        prod_id, base_seq, count, _ = prod
                         key = (prod_id, topic, pid)
                         last = self._pid_state.get(key)
                         expected = 0 if last is None else last[0] + last[1]
@@ -306,10 +401,16 @@ class KafkaStubBroker:
                 w.i32(1).i64(off)
         return bytes(w.buf)
 
-    def _find_coordinator(self, r: Reader) -> bytes:
-        r.string()  # group
+    def _find_coordinator(self, r: Reader, version: int = 0) -> bytes:
+        r.string()  # group / transactional id
         w = Writer()
-        w.i16(0)
+        if version >= 1:
+            r.i8()  # coordinator_type (group=0 / txn=1 — same node here)
+            w.i32(0)  # throttle
+            w.i16(0)  # error
+            w.string(None)  # error_message
+        else:
+            w.i16(0)
         w.i32(0).string("127.0.0.1").i32(self.port)
         return bytes(w.buf)
 

@@ -457,3 +457,92 @@ def test_spout_seek_replays_and_skips(run):
         await cluster.shutdown()
 
     run(go(), timeout=60)
+
+
+def test_transactional_sink_commit_and_abort(run):
+    """TransactionalSink: a failing commit aborts all-or-nothing (records
+    never partially visible) and fails the tuples for replay; the replay
+    commits in a new transaction and every record appears exactly once."""
+    import asyncio
+    import json as _json
+
+    from storm_tpu.config import Config
+    from storm_tpu.connectors import MemoryBroker, TransactionalSink
+    from storm_tpu.runtime import TopologyBuilder
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    class FlakyTxn:
+        """Fails the first commit, then delegates (deterministic chaos)."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.fail_next = 1
+
+        def begin(self):
+            self._inner.begin()
+
+        def produce(self, *a, **kw):
+            self._inner.produce(*a, **kw)
+
+        def commit(self):
+            if self.fail_next:
+                self.fail_next -= 1
+                self._inner.abort()
+                raise RuntimeError("injected commit failure")
+            self._inner.commit()
+
+        def abort(self):
+            self._inner.abort()
+
+    class FlakyBroker(MemoryBroker):
+        def txn(self, txn_id):
+            return FlakyTxn(super().txn(txn_id))
+
+    from storm_tpu.runtime import Spout, Values
+
+    class ReplaySpout(Spout):
+        def open(self, ctx, col):
+            super().open(ctx, col)
+            self.q = [f"m{i}" for i in range(6)] if ctx.task_index == 0 else []
+            self.done = []
+
+        async def next_tuple(self):
+            if not self.q:
+                return False
+            m = self.q.pop(0)
+            await self.collector.emit(Values([m]), msg_id=m)
+            return True
+
+        def ack(self, msg_id):
+            self.done.append(msg_id)
+
+        def fail(self, msg_id):
+            self.q.append(msg_id)  # replay
+
+    async def main():
+        broker = FlakyBroker()
+        tb = TopologyBuilder()
+        tb.set_spout("s", ReplaySpout(), 1)
+        from storm_tpu.config import SinkConfig
+
+        tb.set_bolt("sink", TransactionalSink(
+            broker, "out",
+            SinkConfig(mode="transactional", txn_batch=3, txn_ms=30.0)), 1)\
+            .shuffle_grouping("s")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("txn", Config(), tb.build())
+        deadline = asyncio.get_event_loop().time() + 20
+        while asyncio.get_event_loop().time() < deadline:
+            if broker.topic_size("out") >= 6:
+                break
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.3)
+        recs = broker.drain_topic("out")
+        vals = sorted(r.value.decode() for r in recs)
+        assert vals == [f"m{i}" for i in range(6)], vals  # exactly once
+        snap = rt.metrics.snapshot()
+        assert snap["sink"]["txn_aborts"] == 1
+        assert snap["sink"]["txn_commits"] >= 2
+        await cluster.shutdown()
+
+    run(main(), timeout=60)

@@ -332,3 +332,61 @@ def test_dist_live_model_swap():
             cluster.kill()
     finally:
         stub.close()
+
+
+@pytest.mark.slow
+def test_transactional_sink_over_wire_broker():
+    """sink.mode='transactional' end-to-end over the wire protocol: the
+    standard topology's outputs commit through real EndTxn RPCs."""
+    stub = KafkaStubBroker(partitions=1)
+    try:
+        cfg = Config()
+        cfg.broker.kind = "kafka"
+        cfg.broker.bootstrap = f"127.0.0.1:{stub.port}"
+        cfg.broker.message_format = "v2"
+        cfg.broker.input_topic = "tx-in"
+        cfg.broker.output_topic = "tx-out"
+        cfg.sink.mode = "transactional"
+        cfg.sink.txn_batch = 4
+        cfg.sink.txn_ms = 50.0
+        cfg.model.name = "lenet5"
+        cfg.model.dtype = "float32"
+        cfg.offsets.policy = "earliest"
+        cfg.offsets.max_behind = None
+        cfg.batch.max_batch = 4
+        cfg.batch.buckets = (4,)
+        cfg.topology.spout_parallelism = 1
+        cfg.topology.inference_parallelism = 1
+        cfg.topology.sink_parallelism = 1
+
+        import asyncio
+
+        from storm_tpu.main import _make_broker, build_standard_topology
+        from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+        async def go():
+            broker = _make_broker(cfg)
+            topo = build_standard_topology(cfg, broker)
+            cluster = AsyncLocalCluster()
+            rt = await cluster.submit("txe2e", cfg, topo)
+            from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+            producer = KafkaWireBroker(cfg.broker.bootstrap)
+            rng = np.random.RandomState(0)
+            for _ in range(7):
+                producer.produce("tx-in", json.dumps(
+                    {"instances": rng.rand(1, 28, 28, 1).tolist()}))
+            deadline = asyncio.get_event_loop().time() + 60
+            while asyncio.get_event_loop().time() < deadline:
+                if stub.topic_size("tx-out") >= 7:
+                    break
+                await asyncio.sleep(0.1)
+            assert stub.topic_size("tx-out") == 7
+            snap = rt.metrics.snapshot()
+            assert snap["kafka-bolt"]["txn_commits"] >= 1
+            await rt.drain()
+            await cluster.shutdown()
+
+        asyncio.new_event_loop().run_until_complete(go())
+    finally:
+        stub.close()

@@ -505,3 +505,44 @@ def test_idempotent_broker_wrapper_sequences():
         b.close()
     finally:
         stub.close()
+
+
+def test_kafka_txn_commit_abort_fencing():
+    """KafkaTxn over the wire: commit makes records visible atomically,
+    abort drops them, and a re-initialized transactional id fences the
+    old producer (INVALID_PRODUCER_EPOCH)."""
+    from storm_tpu.connectors.kafka_protocol import (
+        KafkaProtocolError, KafkaWireBroker)
+
+    stub = KafkaStubBroker(partitions=1)
+    try:
+        b = KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2")
+        txn = b.txn("txn-test-0")
+        txn.begin()
+        txn.produce("t", b"a")
+        txn.produce("t", b"b")
+        assert stub.topic_size("t") == 0  # buffered, not visible
+        txn.commit()
+        assert stub.topic_size("t") == 2
+
+        txn.begin()
+        txn.produce("t", b"dropped")
+        txn.abort()
+        assert stub.topic_size("t") == 2
+
+        # zombie fencing: a second handle re-inits the same txn id (epoch
+        # bump); the old handle's next transaction is rejected
+        b2 = KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2")
+        t2 = b2.txn("txn-test-0")
+        t2.begin()
+        txn.begin()  # zombie: stale epoch
+        with pytest.raises(KafkaProtocolError):
+            txn.produce("t", b"zombie")
+            txn.commit()
+        t2.produce("t", b"winner")
+        t2.commit()
+        vals = [r.value for r in b.fetch("t", 0, 0)]
+        assert vals == [b"a", b"b", b"winner"]
+        b.close(); b2.close()
+    finally:
+        stub.close()

@@ -224,4 +224,4 @@ def test_record_batch_roundtrip_with_producer_fields(records, pid, epoch, seq):
     assert [(r.key, r.value) for r in got] == [
         (k, v) for k, v in records]
     fields = KafkaStubBroker._batch_producer_fields(data)
-    assert fields == (pid, seq, len(records))
+    assert fields == (pid, seq, len(records), epoch)
