@@ -1,13 +1,13 @@
 from storm_tpu.connectors.memory import MemoryBroker, Record
 from storm_tpu.connectors.spout import BrokerSpout
 from storm_tpu.connectors.sink import (BrokerSink, DefaultTopicSelector,
-                                       TransactionalSink)
+                                       TransactionalBrokerSink)
 
 __all__ = [
     "MemoryBroker",
     "Record",
     "BrokerSpout",
     "BrokerSink",
-    "TransactionalSink",
+    "TransactionalBrokerSink",
     "DefaultTopicSelector",
 ]

@@ -11,9 +11,11 @@ the same era as the reference's Kafka 0.11 (pom.xml:55-78):
   (CRC32C + zigzag-varint records; ``message_format='v2'``)
 - Fetch v2 (api 1) — brokers down-convert to message format v1
 - ListOffsets v0 (api 2) — latest (-1) / earliest (-2)
-- FindCoordinator v0 (api 10) — group coordinator for offset storage
+- FindCoordinator v0/v1 (api 10) — group + transaction coordinators
 - OffsetCommit v2 (api 8) / OffsetFetch v1 (api 9) — "simple consumer"
   commits (generation -1, empty member), no group-membership protocol
+- InitProducerId v0 (api 22), AddPartitionsToTxn v0 (api 24), EndTxn v0
+  (api 26) — KIP-98 idempotent + transactional produce
 
 Produced messages are uncompressed (attributes=0); fetched gzip wrapper
 messages from other producers are decompressed (relative inner offsets per
@@ -1192,7 +1194,7 @@ class KafkaWireBroker:
 class KafkaTxn:
     """One Kafka transaction bound to a ``transactional_id`` (KIP-98).
 
-    Usage (the TransactionalSink's loop)::
+    Usage (the TransactionalBrokerSink's loop)::
 
         txn = broker.txn("sink-topo-kafka-bolt-0")   # once per task
         txn.begin(); txn.produce(...); ...; txn.commit()   # per batch

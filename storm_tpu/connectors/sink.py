@@ -202,7 +202,7 @@ class BrokerSink(Bolt):
         self.producer.close()
 
 
-class TransactionalSink(BrokerSink):
+class TransactionalBrokerSink(BrokerSink):
     """Exactly-once egress (KIP-98 transactions): tuples buffer into one
     Kafka transaction per micro-batch and ack only after EndTxn(commit) —
     a read-committed consumer sees each batch all-or-nothing. On any

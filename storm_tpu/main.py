@@ -31,10 +31,10 @@ from storm_tpu.utils.logging import setup_logging
 
 
 def _make_sink(cfg: Config, broker, topic):
-    from storm_tpu.connectors import BrokerSink, TransactionalSink
+    from storm_tpu.connectors import BrokerSink, TransactionalBrokerSink
 
     if cfg.sink.mode == "transactional":
-        return TransactionalSink(broker, topic, cfg.sink)
+        return TransactionalBrokerSink(broker, topic, cfg.sink)
     return BrokerSink(broker, topic, cfg.sink)
 
 

@@ -467,7 +467,7 @@ def test_transactional_sink_commit_and_abort(run):
     import json as _json
 
     from storm_tpu.config import Config
-    from storm_tpu.connectors import MemoryBroker, TransactionalSink
+    from storm_tpu.connectors import MemoryBroker, TransactionalBrokerSink
     from storm_tpu.runtime import TopologyBuilder
     from storm_tpu.runtime.cluster import AsyncLocalCluster
 
@@ -525,7 +525,7 @@ def test_transactional_sink_commit_and_abort(run):
         tb.set_spout("s", ReplaySpout(), 1)
         from storm_tpu.config import SinkConfig
 
-        tb.set_bolt("sink", TransactionalSink(
+        tb.set_bolt("sink", TransactionalBrokerSink(
             broker, "out",
             SinkConfig(mode="transactional", txn_batch=3, txn_ms=30.0)), 1)\
             .shuffle_grouping("s")
