@@ -301,11 +301,13 @@ def main() -> None:
     ap.add_argument("--transfer-dtype", default=None, choices=["uint8"],
                     help="quantize the host->device wire to uint8 (4x fewer "
                          "bytes than f32 over the link; lossy, opt-in)")
-    ap.add_argument("--chunk", type=int, default=1,
+    ap.add_argument("--chunk", type=int, default=4,
                     help="spout chunking: records per emitted tuple (1 = "
                          "per-record tuples, the reference's granularity; "
                          "N>1 cuts ledger/executor overhead for small "
-                         "payloads at chunk-replay granularity)")
+                         "payloads at chunk-replay granularity). Default 4: "
+                         "interleaved A/B beat chunk=1 in every pairing "
+                         "(BENCH_NOTES.md)")
     ap.add_argument("--skip-latency", action="store_true")
     args = ap.parse_args()
     if args.config == "multi":
