@@ -178,6 +178,10 @@ class TopologyConfig:
     inference_parallelism: int = 4  # INFERENCE_BOLT_PARAL
     sink_parallelism: int = 2  # KAFKA_BOLT_PARAL
     max_spout_pending: int = 2048  # in-flight roots per spout instance
+    # Records per emitted spout tuple. 1 = the reference's per-record
+    # granularity; N>1 amortizes ledger/executor overhead at high message
+    # rates (replay granularity becomes the chunk). BENCH_NOTES.md.
+    spout_chunk: int = 1
     message_timeout_s: float = 30.0  # at-least-once replay timeout
     inbox_capacity: int = 4096  # bounded executor queues (backpressure)
     tick_interval_s: float = 0.0  # 0 = no tick tuples
@@ -248,6 +252,9 @@ class PipelineConfig:
     input_topic: str = "input"
     output_topic: str = "output"
     dead_letter_topic: str = "dead-letter"
+    # Records per spout tuple for THIS pipeline; 0 = inherit
+    # topology.spout_chunk.
+    spout_chunk: int = 0
     spout_parallelism: int = 1
     inference_parallelism: int = 1
     sink_parallelism: int = 1

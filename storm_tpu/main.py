@@ -47,7 +47,8 @@ def build_standard_topology(cfg: Config, broker):
     tb = TopologyBuilder()
     tb.set_spout(
         "kafka-spout",
-        BrokerSpout(broker, cfg.broker.input_topic, cfg.offsets),
+        BrokerSpout(broker, cfg.broker.input_topic, cfg.offsets,
+                    chunk=cfg.topology.spout_chunk),
         parallelism=cfg.topology.spout_parallelism,
     )
     tb.set_bolt(
@@ -88,7 +89,8 @@ def build_multi_model_topology(cfg: Config, broker):
         infer_id = f"{p.name}-inference"
         tb.set_spout(
             spout_id,
-            BrokerSpout(broker, p.input_topic, p.offsets),
+            BrokerSpout(broker, p.input_topic, p.offsets,
+                        chunk=p.spout_chunk or cfg.topology.spout_chunk),
             parallelism=p.spout_parallelism,
         )
         tb.set_bolt(

@@ -101,3 +101,42 @@ def test_e2e_latency_histogram_recorded(run):
     lat = snap["kafka-bolt"]["e2e_latency_ms"]
     assert lat["count"] == 4
     assert lat["p50"] > 0
+
+
+def test_standard_topology_spout_chunk_config(run):
+    """topology.spout_chunk=N flows into the built spout and the pipeline
+    still delivers every record."""
+    from storm_tpu.main import _make_broker, build_standard_topology
+
+    cfg = Config()
+    cfg.model.name = "lenet5"
+    cfg.model.dtype = "float32"
+    cfg.offsets.policy = "earliest"
+    cfg.offsets.max_behind = None
+    cfg.batch.max_batch = 8
+    cfg.batch.buckets = (8,)
+    cfg.topology.spout_chunk = 3
+    cfg.topology.spout_parallelism = 1
+    cfg.topology.inference_parallelism = 1
+    cfg.topology.sink_parallelism = 1
+
+    async def go():
+        broker = _make_broker(cfg)
+        topo = build_standard_topology(cfg, broker)
+        assert topo.specs["kafka-spout"].obj.chunk == 3
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("chunked", cfg, topo)
+        rng = np.random.RandomState(0)
+        for _ in range(7):  # not a multiple of the chunk
+            broker.produce("input", json.dumps(
+                {"instances": rng.rand(1, 28, 28, 1).tolist()}))
+        deadline = asyncio.get_event_loop().time() + 30
+        while asyncio.get_event_loop().time() < deadline:
+            if broker.topic_size("output") >= 7:
+                break
+            await asyncio.sleep(0.05)
+        assert broker.topic_size("output") == 7
+        await rt.drain()
+        await cluster.shutdown()
+
+    run(go(), timeout=60)
