@@ -1261,8 +1261,15 @@ class KafkaTxn:
                     self._seqs[(topic, partition)] = \
                         (seq + len(records)) & 0x7FFFFFFF
             self._client.end_txn(self.txn_id, self._pid, self._epoch, commit)
-        except KafkaProtocolError:
-            # Fenced / coordinator lost the txn: force a fresh epoch on the
-            # next begin() rather than wedging this id.
+        except Exception:
+            # Fenced / coordinator lost the txn — OR the socket died mid-way
+            # (OSError/ConnectionError): in every failure case the
+            # coordinator may still hold this transaction OPEN with records
+            # already appended.  Force a fresh InitProducerId on the next
+            # begin(): the epoch bump makes the coordinator abort the
+            # dangling transaction (KIP-98 fencing), so the replayed batch
+            # cannot be committed together with the failed attempt's
+            # records.  Resetting only on KafkaProtocolError left network
+            # failures re-using the open txn and double-committing.
             self._pid = None
             raise

@@ -293,15 +293,24 @@ class TransactionalBrokerSink(BrokerSink):
                 self.collector.report_error(e)
                 for t, *_ in batch:
                     self.collector.fail(t)
-                return
-            self._m_commits.inc()
-            for t, *_ in batch:
-                self._ack_delivered(t)
+            else:
+                self._m_commits.inc()
+                for t, *_ in batch:
+                    self._ack_delivered(t)
             # Re-arm the deadline for tuples that arrived while this flush
-            # held the lock — without it they could sit unflushed until
-            # another tuple shows up (and then double-commit after replay).
-            if self._buf and (self._deadline_task is None
-                              or self._deadline_task.done()):
+            # held the lock — on BOTH the commit and the failed/abort path
+            # (a failed flush leaves mid-flush arrivals just as stranded) —
+            # without it they could sit unflushed until another tuple shows
+            # up (and then double-commit after replay).
+            # NB: when THIS flush was triggered by the deadline task, that
+            # task is still `running` (it is us), so `.done()` is False —
+            # treat the currently-executing task as done or the re-arm is
+            # skipped and the buffered tuples sit unacked until tree
+            # timeout + replay (the double-commit this branch prevents).
+            stale = (self._deadline_task is None
+                     or self._deadline_task.done()
+                     or self._deadline_task is asyncio.current_task())
+            if self._buf and stale:
                 self._deadline_task = asyncio.get_running_loop().create_task(
                     self._deadline_flush())
 

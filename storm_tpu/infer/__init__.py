@@ -1,4 +1,15 @@
-from storm_tpu.infer.engine import InferenceEngine, shared_engine
+from storm_tpu.infer.engine import (
+    InferenceEngine,
+    set_engine_cache_limit,
+    shared_engine,
+    unload_engine,
+)
 from storm_tpu.infer.operator import InferenceBolt
 
-__all__ = ["InferenceEngine", "shared_engine", "InferenceBolt"]
+__all__ = [
+    "InferenceEngine",
+    "shared_engine",
+    "unload_engine",
+    "set_engine_cache_limit",
+    "InferenceBolt",
+]

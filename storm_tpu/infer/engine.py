@@ -15,7 +15,10 @@ Outputs are softmax probabilities, matching the reference's fetch of
 from __future__ import annotations
 
 import logging
+import sys
 import threading
+from collections import OrderedDict
+from concurrent.futures import Future
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -283,8 +286,16 @@ class InferenceEngine:
 
 # ---- engine sharing across operator tasks ------------------------------------
 
-_ENGINES: Dict[tuple, InferenceEngine] = {}
+_ENGINES: "OrderedDict[tuple, InferenceEngine]" = OrderedDict()
 _ENGINES_LOCK = threading.Lock()
+# key -> in-progress build; concurrent shared_engine calls for the same key
+# wait on it instead of each allocating a full duplicate param copy.
+_BUILDS: Dict[tuple, Future] = {}
+# Optional hard cap on total cached param bytes; None = cap at 85% of the
+# device HBM limit when known (the threshold round 1 only warned about).
+# Eviction only ever drops engines nothing outside the cache references,
+# so a cap can never force a live engine to be rebuilt as a duplicate.
+_ENGINE_CACHE_LIMIT: Optional[int] = None
 
 
 def _freeze(v):
@@ -327,10 +338,102 @@ def shared_engine(
         (batch_cfg.max_batch, tuple(batch_cfg.buckets)) if batch_cfg else None,
     )
     with _ENGINES_LOCK:
-        if key not in _ENGINES:
-            _ENGINES[key] = InferenceEngine(model_cfg, sharding_cfg, batch_cfg)
+        if key in _ENGINES:
+            _ENGINES.move_to_end(key)  # LRU: most-recently-used last
+            return _ENGINES[key]
+        fut = _BUILDS.get(key)
+        owner = fut is None
+        if owner:
+            fut = Future()
+            _BUILDS[key] = fut
+    if not owner:
+        # Another thread owns the build: wait for its result instead of
+        # allocating a duplicate param copy — N bolt tasks swapping the
+        # same model concurrently must cost ONE build (param HBM +
+        # compile), not N.
+        return fut.result()
+    # We own the build. Build OUTSIDE the lock: compile can take tens of
+    # seconds and the UI thread polls engine_inventory under this lock.
+    try:
+        engine = InferenceEngine(model_cfg, sharding_cfg, batch_cfg)
+    except BaseException as e:
+        with _ENGINES_LOCK:
+            _BUILDS.pop(key, None)
+        fut.set_exception(e)
+        raise
+    try:
+        with _ENGINES_LOCK:
+            _ENGINES[key] = engine
+            _BUILDS.pop(key, None)
+            _evict_to_budget_locked(keep=key)
             _log_hbm_inventory()
-        return _ENGINES[key]
+    finally:
+        # Resolve the future even if eviction/logging raised: the engine IS
+        # cached by then, and waiters parked on fut.result() (no timeout)
+        # would otherwise hang forever.
+        fut.set_result(engine)
+    return engine
+
+
+def unload_engine(engine: InferenceEngine) -> bool:
+    """Drop ``engine`` from the process cache so its HBM can be reclaimed
+    once no bolt references it (live model swaps otherwise accumulate
+    rollback engines forever). Returns True if it was cached."""
+    with _ENGINES_LOCK:
+        for k, e in list(_ENGINES.items()):
+            if e is engine:
+                del _ENGINES[k]
+                return True
+    return False
+
+
+def set_engine_cache_limit(max_param_bytes: Optional[int]) -> None:
+    """Cap total cached engine param bytes; least-recently-used engines are
+    dropped from the cache on the next ``shared_engine`` insert. ``None``
+    restores the default (85% of device HBM when the backend reports it)."""
+    global _ENGINE_CACHE_LIMIT
+    with _ENGINES_LOCK:
+        _ENGINE_CACHE_LIMIT = max_param_bytes
+
+
+def _externally_referenced(e: InferenceEngine) -> bool:
+    """Best-effort: does anything OUTSIDE the cache still hold ``e``?
+    CPython refcount accounting: getrefcount's argument temp + this frame's
+    local + the _ENGINES dict value = 3 internal refs. Non-CPython lacks
+    getrefcount semantics — treat everything as referenced (never evict;
+    degrades to round 1's warn-only behavior, which is safe)."""
+    try:
+        return sys.getrefcount(e) > 3
+    except Exception:  # pragma: no cover - non-CPython
+        return True
+
+
+def _evict_to_budget_locked(keep: tuple) -> None:
+    limit = _ENGINE_CACHE_LIMIT
+    if limit is None:
+        hbm = _device_hbm_limit()
+        limit = int(0.85 * hbm) if hbm else None
+    if limit is None:
+        return
+    total = sum(e.param_bytes() for e in _ENGINES.values())
+    for k in list(_ENGINES):  # oldest first
+        if total <= limit:
+            break
+        if k == keep:  # never evict the engine being handed out
+            continue
+        if _externally_referenced(_ENGINES[k]):
+            # A bolt still serves from it: evicting would free nothing AND
+            # make the next lookup build a duplicate param copy — worse HBM
+            # pressure than doing nothing. Only orphans (e.g. rollback
+            # engines left behind by completed model swaps) are dropped.
+            continue
+        e = _ENGINES.pop(k)
+        total -= e.param_bytes()
+        logger.info(
+            "evicted orphaned LRU engine %s (%.1fMB) from cache "
+            "(budget %.1fMB)",
+            e.model_cfg.name, e.param_bytes() / 1e6, limit / 1e6)
+        del e  # drop the last reference -> HBM reclaimed
 
 
 def engine_inventory() -> dict:

@@ -167,9 +167,16 @@ class InferenceBolt(Bolt):
             if batch is not None:
                 self._eager_pending += 1
                 task = asyncio.get_running_loop().create_task(
-                    self._dispatch(batch, eager=True))
+                    self._dispatch(batch))
                 self._inflight.add(task)
                 task.add_done_callback(self._inflight.discard)
+                # Decrement when the task finishes — however it finishes.
+                # A cancel BEFORE the coroutine's first step never enters
+                # _dispatch, so an in-body decrement would leak the counter
+                # and permanently disable eager dispatch for this bolt.
+                task.add_done_callback(
+                    lambda _t: setattr(
+                        self, "_eager_pending", self._eager_pending - 1))
                 return
         if len(self.batcher) and (self._flush_task is None or self._flush_task.done()):
             self._flush_task = asyncio.get_running_loop().create_task(
@@ -231,10 +238,11 @@ class InferenceBolt(Bolt):
             if batch is not None:
                 await self._dispatch(batch)
 
-    async def _dispatch(self, batch: Batch, eager: bool = False) -> None:
+    async def _dispatch(self, batch: Batch) -> None:
+        # NB: _eager_pending is decremented by a done-callback on the eager
+        # task (see _kick_flush), NOT here — a cancel while parked on the
+        # semaphore (or before the first step) must still restore it.
         await self._dispatch_sem.acquire()
-        if eager:
-            self._eager_pending -= 1
         task = asyncio.get_running_loop().create_task(self._run_batch(batch))
         self._inflight.add(task)
         task.add_done_callback(self._inflight.discard)

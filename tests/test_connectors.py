@@ -546,3 +546,91 @@ def test_transactional_sink_commit_and_abort(run):
         await cluster.shutdown()
 
     run(main(), timeout=60)
+
+
+def test_transactional_sink_rearms_deadline_after_own_flush(run):
+    """Tuples that arrive WHILE a deadline-triggered flush is committing
+    must get a fresh deadline timer: the flushing task is the deadline task
+    itself (`.done()` is False), so the old re-arm check skipped them and
+    they sat unacked until tree-timeout replay — the double-commit the
+    re-arm exists to prevent. Regression for ADVICE r1 (sink.py:303)."""
+    import time as _time
+
+    from storm_tpu.config import Config, SinkConfig
+    from storm_tpu.connectors import MemoryBroker, TransactionalBrokerSink
+    from storm_tpu.runtime import Spout, TopologyBuilder, Values
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    class SlowTxn:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def begin(self):
+            self._inner.begin()
+
+        def produce(self, *a, **kw):
+            self._inner.produce(*a, **kw)
+
+        def commit(self):
+            _time.sleep(0.25)  # commit in flight while tuple "b" arrives
+            self._inner.commit()
+
+        def abort(self):
+            self._inner.abort()
+
+    class SlowBroker(MemoryBroker):
+        blocking = True  # sink runs txns on a worker thread
+
+        def txn(self, txn_id):
+            return SlowTxn(super().txn(txn_id))
+
+    class TwoPhaseSpout(Spout):
+        def open(self, ctx, col):
+            super().open(ctx, col)
+            self.plan = [("a", 0.0), ("b", 0.1)] if ctx.task_index == 0 else []
+            self.t0 = _time.monotonic()
+            self.acked, self.failed = [], []
+
+        async def next_tuple(self):
+            if not self.plan:
+                return False
+            m, at = self.plan[0]
+            if _time.monotonic() - self.t0 < at:
+                return False
+            self.plan.pop(0)
+            await self.collector.emit(Values([m]), msg_id=m)
+            return True
+
+        def ack(self, msg_id):
+            self.acked.append(msg_id)
+
+        def fail(self, msg_id):
+            self.failed.append(msg_id)
+
+    async def main():
+        broker = SlowBroker()
+        tb = TopologyBuilder()
+        tb.set_spout("s", TwoPhaseSpout(), 1)
+        # batch=100 so only the deadline (30ms) ever triggers a flush:
+        # t=30ms flush("a") starts, commit blocks 250ms; t=100ms "b" arrives
+        # mid-flush; the re-armed deadline must flush "b" ~30ms after.
+        tb.set_bolt("sink", TransactionalBrokerSink(
+            broker, "out",
+            SinkConfig(mode="transactional", txn_batch=100, txn_ms=30.0)), 1)\
+            .shuffle_grouping("s")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("txn-rearm", Config(), tb.build())
+        spout = rt.spout_execs["s"][0].spout
+        deadline = asyncio.get_event_loop().time() + 3.0
+        while asyncio.get_event_loop().time() < deadline:
+            if len(spout.acked) >= 2:
+                break
+            await asyncio.sleep(0.02)
+        # Well under any tree timeout: both tuples committed+acked promptly.
+        assert sorted(spout.acked) == ["a", "b"], (spout.acked, spout.failed)
+        assert spout.failed == []
+        recs = broker.drain_topic("out")
+        assert sorted(r.value.decode() for r in recs) == ["a", "b"]
+        await cluster.shutdown()
+
+    run(main(), timeout=30)

@@ -522,3 +522,152 @@ def test_canary_swap_single_task(run):
         await cluster.shutdown()
 
     run(go(), timeout=120)
+
+
+def test_eager_pending_restored_on_cancelled_dispatch(run):
+    """An eager dispatch task cancelled during shutdown/drain — whether
+    parked on the device-slot semaphore OR before its first step — must
+    still decrement _eager_pending, or eager dispatch is permanently
+    disabled for the bolt instance. Regression for ADVICE r1
+    (operator.py:237) + review r2 (pre-first-step cancel window)."""
+    import asyncio
+
+    from storm_tpu.infer.operator import InferenceBolt
+
+    class FakeBatcher:
+        def __len__(self):
+            return 1
+
+        def take_all(self):
+            return "batch"  # never reaches the engine: task is cancelled
+
+    def skeleton(slots):
+        bolt = object.__new__(InferenceBolt)  # no engine needed: cancelled
+        bolt._eager = True
+        bolt._eager_pending = 0
+        bolt._dispatch_sem = asyncio.Semaphore(slots)
+        bolt._inflight = set()
+        bolt._flush_task = None
+        bolt.batcher = FakeBatcher()
+        return bolt
+
+    async def main():
+        # Window A: cancelled BEFORE the coroutine's first step (the task
+        # never enters _dispatch, so only a done-callback can decrement).
+        bolt = skeleton(slots=1)
+        bolt._kick_flush()
+        assert bolt._eager_pending == 1
+        task = next(iter(bolt._inflight))
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        assert bolt._eager_pending == 0
+
+        # Window B: cancelled while parked on the semaphore. Slot is free
+        # at kick time (eager branch fires), then stolen before the task's
+        # first step — the task parks on acquire.
+        bolt = skeleton(slots=1)
+        bolt._kick_flush()
+        assert bolt._eager_pending == 1
+        await bolt._dispatch_sem.acquire()  # steal the slot
+        task = next(iter(bolt._inflight))
+        await asyncio.sleep(0.01)  # let it park on the semaphore
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        assert bolt._eager_pending == 0
+
+    run(main(), timeout=10)
+
+
+def test_engine_cache_unload_and_lru_eviction():
+    """shared_engine's process cache must be boundable: set a byte budget
+    and LRU engines are dropped on insert; unload_engine drops a specific
+    engine (e.g. after a completed model swap). Regression for ADVICE r1
+    (engine.py:329 — cache grew monotonically across live swaps)."""
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.infer.engine import (
+        _ENGINES, set_engine_cache_limit, shared_engine, unload_engine)
+
+    scfg = ShardingConfig(data_parallel=0)
+    bcfg = BatchConfig(max_batch=4, buckets=(4,))
+
+    def eng(seed):
+        return shared_engine(
+            ModelConfig(name="lenet5", input_shape=(28, 28, 1),
+                        dtype="float32", seed=seed), scfg, bcfg)
+
+    import gc
+
+    def cached_seeds():
+        # seed is a stable component of the cache key (position 6)
+        return {k[6] for k in _ENGINES}
+
+    gc.collect()  # drop cycles from earlier tests so orphan-detection is crisp
+    try:
+        e1 = eng(101)
+        one_engine_bytes = e1.param_bytes()
+        # Budget fits exactly one lenet5: inserting a second wants to evict
+        # the LRU — but e1 is still referenced by this frame (a live bolt),
+        # so it must be SKIPPED (evicting would free nothing and force a
+        # duplicate rebuild on the next lookup).
+        set_engine_cache_limit(one_engine_bytes + 1)
+        e2 = eng(102)
+        assert e1 in list(_ENGINES.values())  # referenced -> kept
+        assert e2 in list(_ENGINES.values())
+        # Drop the external reference (bolt gone / swap completed): now the
+        # orphan is evictable on the next insert.
+        del e1
+        e3 = eng(103)
+        cached = list(_ENGINES.values())
+        assert e2 in cached and e3 in cached  # referenced -> kept
+        assert 101 not in cached_seeds()  # the orphan was evicted
+        # Cache hit returns the same object and keeps it resident.
+        assert eng(102) is e2
+
+        # Explicit unload (post-swap rollback-cache cleanup).
+        assert unload_engine(e2) is True
+        assert e2 not in list(_ENGINES.values())
+        assert unload_engine(e2) is False  # already gone
+    finally:
+        set_engine_cache_limit(None)
+
+
+
+def test_shared_engine_concurrent_requests_build_once():
+    """N tasks requesting the same engine concurrently (e.g. a model swap
+    broadcast to every bolt task) must cost ONE build — one param copy in
+    HBM, one compile — with the others waiting on the in-progress build."""
+    import threading
+    import time as _time
+
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.infer import engine as eng_mod
+
+    builds = []
+    orig_init = eng_mod.InferenceEngine.__init__
+
+    def counting_init(self, *a, **kw):
+        builds.append(threading.get_ident())
+        _time.sleep(0.2)  # widen the race window
+        orig_init(self, *a, **kw)
+
+    eng_mod.InferenceEngine.__init__ = counting_init
+    try:
+        results = []
+
+        def go():
+            results.append(eng_mod.shared_engine(
+                ModelConfig(name="lenet5", input_shape=(28, 28, 1),
+                            dtype="float32", seed=201),
+                ShardingConfig(data_parallel=0),
+                BatchConfig(max_batch=4, buckets=(4,))))
+
+        threads = [threading.Thread(target=go) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(builds) == 1, f"expected 1 build, got {len(builds)}"
+        assert len(results) == 4
+        assert all(r is results[0] for r in results)
+    finally:
+        eng_mod.InferenceEngine.__init__ = orig_init

@@ -546,3 +546,45 @@ def test_kafka_txn_commit_abort_fencing():
         b.close(); b2.close()
     finally:
         stub.close()
+
+
+def test_kafka_txn_network_failure_resets_producer_id():
+    """A socket-level failure (OSError) mid-transaction must reset the
+    producer id so the next begin() re-runs InitProducerId: the epoch bump
+    makes the coordinator abort the dangling open transaction. Without the
+    reset, the replay is produced into the SAME open transaction and the
+    eventual commit makes both the failed attempt's records and the replay
+    visible — duplicates under read-committed (exactly-once broken)."""
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+    stub = KafkaStubBroker(partitions=1)
+    try:
+        b = KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2")
+        txn = b.txn("txn-net-0")
+        txn.begin()
+        txn.produce("t", b"attempt1")
+
+        real_end_txn = b.client.end_txn
+
+        def dead_socket(*a, **kw):
+            raise OSError("connection reset by peer")
+
+        # Records get appended (add_partitions + produce succeed), then the
+        # socket dies on EndTxn: coordinator still holds the txn OPEN.
+        b.client.end_txn = dead_socket
+        with pytest.raises(OSError):
+            txn.commit()
+        assert txn._pid is None  # forces InitProducerId on next begin()
+        b.client.end_txn = real_end_txn
+
+        # Replay path: fresh begin() bumps the epoch, which drops the
+        # dangling transaction's pending records at the coordinator.
+        txn.begin()
+        txn.produce("t", b"replay")
+        txn.commit()
+
+        vals = [r.value for r in b.fetch("t", 0, 0)]
+        assert vals == [b"replay"], vals  # attempt1 aborted, no duplicate
+        b.close()
+    finally:
+        stub.close()
