@@ -28,7 +28,11 @@ import numpy as np
 from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
 from storm_tpu.models.registry import ModelDef, build_model, load_or_init
 from storm_tpu.parallel.mesh import make_mesh
-from storm_tpu.parallel.sharding import batch_sharding, replicated
+from storm_tpu.parallel.sharding import (
+    batch_sharding,
+    replicated,
+    shard_params_tp,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -143,6 +147,22 @@ class InferenceEngine:
         cast = lambda t: jax.tree.map(
             lambda a: a.astype(self.dtype) if a.dtype == jnp.float32 else a, t
         )
+        # Param placement: replicate on a pure-DP mesh; Megatron-style TP
+        # shard when the mesh has a non-trivial model axis. This is what the
+        # reference structurally cannot do — its model is one opaque blob
+        # per bolt (InferenceBolt.java:57), so a model that doesn't fit one
+        # device cannot be served; here `tensor_parallel > 1` splits the
+        # attention/MLP kernels across the model axis and XLA inserts the
+        # ICI psum on the row-parallel matmuls.
+        self.model_axis = (
+            self.sharding_cfg.axis_names[1]
+            if len(self.sharding_cfg.axis_names) > 1 else "model")
+        self.tp = int(self.mesh.shape.get(self.model_axis, 1))
+        if self.tp > 1:
+            place_params = lambda t: shard_params_tp(
+                self.mesh, t, self.model_axis)
+        else:
+            place_params = lambda t: jax.device_put(t, replicated(self.mesh))
         # BN statistics stay f32 (cast only f32 leaves to compute dtype would
         # nuke them too) — so cast params only; state is small and stays f32.
         self._w8 = getattr(model_cfg, "weights", "float") in (
@@ -159,10 +179,14 @@ class InferenceEngine:
                     l.astype(self.dtype) if l.dtype == jnp.float32 else l),
                 quantize_params(params), is_leaf=_is_qleaf,
             )
-            self.params = jax.device_put(qtree, replicated(self.mesh))
+            self.params = place_params(qtree)
         else:
-            self.params = jax.device_put(cast(params), replicated(self.mesh))
+            self.params = place_params(cast(params))
         self.state = jax.device_put(state, replicated(self.mesh))
+        # jit must pin params to their committed placement (replicated OR
+        # TP-sharded) — read the shardings off the placed arrays so both
+        # paths share one code path.
+        p_shardings = jax.tree.map(lambda a: a.sharding, self.params)
 
         apply = self.model.apply
         x_shard = batch_sharding(self.mesh, self.data_axis)
@@ -180,7 +204,7 @@ class InferenceEngine:
 
         self._fwd = jax.jit(
             fwd,
-            in_shardings=(replicated(self.mesh), replicated(self.mesh), x_shard),
+            in_shardings=(p_shardings, replicated(self.mesh), x_shard),
             out_shardings=x_shard,
         )
         # uint8 transfer path: the wire carries affine-quantized bytes plus a
@@ -195,7 +219,7 @@ class InferenceEngine:
         self._fwd_q = jax.jit(
             fwd_q,
             in_shardings=(
-                replicated(self.mesh),
+                p_shardings,
                 replicated(self.mesh),
                 x_shard,
                 replicated(self.mesh),
@@ -217,6 +241,19 @@ class InferenceEngine:
             x.nbytes for t in (self.params, self.state)
             for x in jax.tree.leaves(t) if hasattr(x, "nbytes")
         )
+
+    def param_bytes_per_device(self) -> int:
+        """Largest per-device slice of params+state actually resident in
+        HBM. Pure DP: equals :meth:`param_bytes` (full replica everywhere).
+        TP: the sharded kernels contribute ~1/tp each, so a model bigger
+        than one chip's HBM fits when ``param_bytes_per_device`` does."""
+        per: Dict[int, int] = {}
+        for t in (self.params, self.state):
+            for x in jax.tree.leaves(t):
+                for s in getattr(x, "addressable_shards", ()):
+                    did = s.device.id
+                    per[did] = per.get(did, 0) + s.data.nbytes
+        return max(per.values(), default=0)
 
     # ---- shape management ----------------------------------------------------
 
@@ -361,17 +398,18 @@ def shared_engine(
             _BUILDS.pop(key, None)
         fut.set_exception(e)
         raise
-    try:
-        with _ENGINES_LOCK:
-            _ENGINES[key] = engine
-            _BUILDS.pop(key, None)
+    with _ENGINES_LOCK:
+        _ENGINES[key] = engine
+        _BUILDS.pop(key, None)
+        try:
             _evict_to_budget_locked(keep=key)
             _log_hbm_inventory()
-    finally:
-        # Resolve the future even if eviction/logging raised: the engine IS
-        # cached by then, and waiters parked on fut.result() (no timeout)
-        # would otherwise hang forever.
-        fut.set_result(engine)
+        except Exception:
+            # Bookkeeping only: the engine is built and cached — neither
+            # the owner nor the waiters should fail because eviction or
+            # the inventory log hiccuped.
+            logger.exception("engine cache bookkeeping failed")
+    fut.set_result(engine)
     return engine
 
 
@@ -396,14 +434,32 @@ def set_engine_cache_limit(max_param_bytes: Optional[int]) -> None:
         _ENGINE_CACHE_LIMIT = max_param_bytes
 
 
-def _externally_referenced(e: InferenceEngine) -> bool:
-    """Best-effort: does anything OUTSIDE the cache still hold ``e``?
-    CPython refcount accounting: getrefcount's argument temp + this frame's
-    local + the _ENGINES dict value = 3 internal refs. Non-CPython lacks
-    getrefcount semantics — treat everything as referenced (never evict;
-    degrades to round 1's warn-only behavior, which is safe)."""
+def _refs_of_value(d: dict, k) -> int:
+    """getrefcount of ``d[k]`` through one fixed call shape, so the
+    internal-reference overhead is identical between the calibration probe
+    and the real check (CPython's calling convention changed this count
+    between 3.10 and 3.11 — never hard-code it)."""
+    return sys.getrefcount(d[k])
+
+
+_REF_BASELINE: Optional[int] = None
+
+
+def _ref_baseline() -> int:
+    """Refcount of an object whose ONLY reference is a dict value, measured
+    through :func:`_refs_of_value` at runtime on this interpreter."""
+    global _REF_BASELINE
+    if _REF_BASELINE is None:
+        _REF_BASELINE = _refs_of_value({0: object()}, 0)
+    return _REF_BASELINE
+
+
+def _externally_referenced(k: tuple) -> bool:
+    """Best-effort: does anything OUTSIDE the cache still hold ``_ENGINES[k]``?
+    Non-CPython lacks refcount semantics — treat everything as referenced
+    (never evict; degrades to round 1's warn-only behavior, which is safe)."""
     try:
-        return sys.getrefcount(e) > 3
+        return _refs_of_value(_ENGINES, k) > _ref_baseline()
     except Exception:  # pragma: no cover - non-CPython
         return True
 
@@ -415,24 +471,28 @@ def _evict_to_budget_locked(keep: tuple) -> None:
         limit = int(0.85 * hbm) if hbm else None
     if limit is None:
         return
-    total = sum(e.param_bytes() for e in _ENGINES.values())
+    # Per-DEVICE bytes: the budget is one chip's HBM, and TP-sharded
+    # engines only hold ~1/tp of their params on each device — counting
+    # global bytes would evict orphans that actually fit.
+    total = sum(e.param_bytes_per_device() for e in _ENGINES.values())
     for k in list(_ENGINES):  # oldest first
         if total <= limit:
             break
         if k == keep:  # never evict the engine being handed out
             continue
-        if _externally_referenced(_ENGINES[k]):
+        if _externally_referenced(k):
             # A bolt still serves from it: evicting would free nothing AND
             # make the next lookup build a duplicate param copy — worse HBM
             # pressure than doing nothing. Only orphans (e.g. rollback
             # engines left behind by completed model swaps) are dropped.
             continue
         e = _ENGINES.pop(k)
-        total -= e.param_bytes()
+        per_dev = e.param_bytes_per_device()
+        total -= per_dev
         logger.info(
-            "evicted orphaned LRU engine %s (%.1fMB) from cache "
+            "evicted orphaned LRU engine %s (%.1fMB/device) from cache "
             "(budget %.1fMB)",
-            e.model_cfg.name, e.param_bytes() / 1e6, limit / 1e6)
+            e.model_cfg.name, per_dev / 1e6, limit / 1e6)
         del e  # drop the last reference -> HBM reclaimed
 
 
@@ -448,11 +508,16 @@ def engine_inventory() -> dict:
             "weights": getattr(e.model_cfg, "weights", "float"),
             "dtype": str(e.dtype),
             "param_bytes": e.param_bytes(),
+            # What one chip actually holds (≈ param_bytes/tp when sharded)
+            # — the figure the 85% HBM warning and cache budget use.
+            "param_bytes_per_device": e.param_bytes_per_device(),
         }
         for e in engines
     ]
     return {"engines": rows,
-            "total_param_bytes": sum(r["param_bytes"] for r in rows)}
+            "total_param_bytes": sum(r["param_bytes"] for r in rows),
+            "total_param_bytes_per_device": sum(
+                r["param_bytes_per_device"] for r in rows)}
 
 
 def _device_hbm_limit() -> Optional[int]:
@@ -467,14 +532,19 @@ def _device_hbm_limit() -> Optional[int]:
 
 def _log_hbm_inventory() -> None:
     # Called with _ENGINES_LOCK held (param_bytes only reads engine attrs).
-    rows = [(e.model_cfg.name, e.param_bytes()) for e in _ENGINES.values()]
+    # Per-DEVICE bytes: the limit being compared against is one chip's HBM,
+    # and TP-sharded engines hold only ~1/tp of their params per device.
+    rows = [(e.model_cfg.name, e.param_bytes_per_device())
+            for e in _ENGINES.values()]
     total = sum(b for _, b in rows)
     limit = _device_hbm_limit()
     detail = ", ".join(f"{n}={b / 1e6:.1f}MB" for n, b in rows)
-    logger.info("engine HBM inventory: %s (total %.1fMB)", detail, total / 1e6)
+    logger.info(
+        "engine HBM inventory: %s (total %.1fMB/device)", detail, total / 1e6)
     if limit and total > 0.85 * limit:
         logger.warning(
             "co-resident engine params at %.0f%% of device memory "
-            "(%.1fMB of %.1fMB) — multi-model HBM budget nearly exhausted",
+            "(%.1fMB of %.1fMB per device) — multi-model HBM budget "
+            "nearly exhausted",
             100 * total / limit, total / 1e6, limit / 1e6,
         )
