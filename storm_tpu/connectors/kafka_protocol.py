@@ -173,16 +173,23 @@ def decode_message_set(topic: str, partition: int, data: bytes) -> List[Record]:
         if codec == 0:
             records.append(Record(topic, partition, offset, key, value, ts))
             continue
-        if codec != 1:
+        if codec == 1:
+            import gzip as _gzip
+
+            decompressed = _gzip.decompress(value)
+        elif codec == 2:
+            from storm_tpu.connectors.snappy import decompress as _snappy
+
+            decompressed = _snappy(value)
+        else:
             raise KafkaProtocolError(
-                f"unsupported compression codec {codec} (only gzip=1)"
+                f"unsupported compression codec {codec} "
+                "(gzip=1 and snappy=2 supported; lz4/zstd are not)"
             )
-        # gzip wrapper: the value is an inner message set. For magic 1
+        # compressed wrapper: the value is an inner message set. For magic 1
         # (KIP-31) inner offsets are 0-based relative and the wrapper carries
         # the offset of the LAST inner message; for magic 0 they're absolute.
-        import gzip as _gzip
-
-        inner = decode_message_set(topic, partition, _gzip.decompress(value))
+        inner = decode_message_set(topic, partition, decompressed)
         if magic == 1 and inner:
             base = offset - (len(inner) - 1)
             inner = [
@@ -242,15 +249,16 @@ def encode_record_batch(
     transactional: bool = False,
 ) -> bytes:
     """[(key, value)] -> one RecordBatch (magic 2; ``compression='gzip'``
-    gzips the records block, attrs codec bit 1). CRC32C (Castagnoli)
-    covers everything after the crc field, computed by the native layer
-    when built. ``producer=(producer_id, epoch, base_sequence)`` stamps
-    the KIP-98 idempotence fields (default: -1/-1/-1, non-idempotent)."""
+    gzips the records block, codec bit 1; ``'snappy'`` wraps it in a raw
+    snappy block, codec bit 2). CRC32C (Castagnoli) covers everything
+    after the crc field, computed by the native layer when built.
+    ``producer=(producer_id, epoch, base_sequence)`` stamps the KIP-98
+    idempotence fields (default: -1/-1/-1, non-idempotent)."""
     from storm_tpu.native import crc32c
 
-    if compression not in (None, "gzip"):
+    if compression not in (None, "gzip", "snappy"):
         raise KafkaProtocolError(
-            f"unsupported compression {compression!r} (only gzip)")
+            f"unsupported compression {compression!r} (gzip/snappy)")
     body = bytearray()
     for i, (key, value) in enumerate(records):
         rec = bytearray()
@@ -275,6 +283,11 @@ def encode_record_batch(
 
         payload = _gzip.compress(payload)
         attrs |= 1  # codec bits: gzip
+    elif compression == "snappy":
+        from storm_tpu.connectors import snappy as _snappy
+
+        payload = _snappy.compress(payload)
+        attrs |= 2  # codec bits: snappy
     after_crc = Writer()
     after_crc.i16(attrs)
     after_crc.i32(len(records) - 1)  # lastOffsetDelta
@@ -335,9 +348,16 @@ def decode_record_batch(topic: str, partition: int, data: bytes,
         import gzip as _gzip
 
         payload = _gzip.decompress(payload)
+    elif codec == 2:
+        from storm_tpu.connectors.snappy import decompress as _snappy
+
+        # magic-2 batches carry a raw snappy block (xerial framing is
+        # message-set-era; decompress() sniffs either, defensively).
+        payload = _snappy(payload)
     elif codec != 0:
         raise KafkaProtocolError(
-            f"unsupported record-batch codec {codec} (only none/gzip)")
+            f"unsupported record-batch codec {codec} "
+            "(none/gzip/snappy supported; lz4/zstd are not)")
     records: List[Record] = []
     pos = 0
     for _ in range(count):

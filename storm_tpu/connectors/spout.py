@@ -263,6 +263,21 @@ class BrokerSpout(Spout):
             return True
         return False
 
+    def _append_root_ts(self, rec: Record) -> float:
+        """E2E ingress clock = broker APPEND time, not spout-emit time.
+
+        The north-star metric is Kafka-append -> Kafka-deliver (BASELINE.md);
+        starting the clock at spout emit hides broker-side queueing — e.g.
+        when ``max_spout_pending`` throttles fetches, records age in the log
+        invisibly. ``Record.timestamp`` is wall-clock (epoch seconds, both
+        MemoryBroker and the Kafka wire client); the latency histograms run
+        on ``perf_counter``, so rebase append time onto the perf basis.
+        Clamped to ``now`` so a producer with a skewed-forward clock can't
+        produce negative latency."""
+        now_perf = time.perf_counter()
+        age = time.time() - rec.timestamp
+        return now_perf - max(age, 0.0)
+
     async def _emit_chunk(self, records: "list[Record]") -> None:
         first, last = records[0], records[-1]
         msg_id = ("c", first.partition, first.offset, last.offset)
@@ -270,7 +285,8 @@ class BrokerSpout(Spout):
         await self.collector.emit(
             Values([[r.value.decode("utf-8", "replace") for r in records]]),
             msg_id=msg_id,
-            root_ts=time.perf_counter(),
+            # Oldest record in the chunk: its queueing is the one that counts.
+            root_ts=self._append_root_ts(first),
         )
 
     async def _emit(self, rec: Record) -> None:
@@ -279,7 +295,7 @@ class BrokerSpout(Spout):
         await self.collector.emit(
             Values([rec.value.decode("utf-8", "replace")]),
             msg_id=msg_id,
-            root_ts=time.perf_counter(),
+            root_ts=self._append_root_ts(rec),
         )
 
     @staticmethod

@@ -398,18 +398,22 @@ def shared_engine(
             _BUILDS.pop(key, None)
         fut.set_exception(e)
         raise
-    with _ENGINES_LOCK:
-        _ENGINES[key] = engine
-        _BUILDS.pop(key, None)
-        try:
-            _evict_to_budget_locked(keep=key)
-            _log_hbm_inventory()
-        except Exception:
-            # Bookkeeping only: the engine is built and cached — neither
-            # the owner nor the waiters should fail because eviction or
-            # the inventory log hiccuped.
-            logger.exception("engine cache bookkeeping failed")
-    fut.set_result(engine)
+    try:
+        with _ENGINES_LOCK:
+            _ENGINES[key] = engine
+            _BUILDS.pop(key, None)
+            try:
+                _evict_to_budget_locked(keep=key)
+                _log_hbm_inventory()
+            except Exception:
+                # Bookkeeping only: the engine is built and cached —
+                # neither the owner nor the waiters should fail because
+                # eviction or the inventory log hiccuped.
+                logger.exception("engine cache bookkeeping failed")
+    finally:
+        # ALWAYS resolve — even on BaseException (KeyboardInterrupt) —
+        # or waiters parked on fut.result() (no timeout) hang forever.
+        fut.set_result(engine)
     return engine
 
 

@@ -157,6 +157,10 @@ class InferenceBolt(Bolt):
         )
 
     def _kick_flush(self) -> None:
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return  # loop torn down mid-finalizer (cluster shutdown race)
         if self._eager and len(self.batcher) and \
                 not self._dispatch_sem.locked() and not self._eager_pending:
             # Work-conserving: a device slot is free and records are

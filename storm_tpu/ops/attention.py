@@ -31,11 +31,26 @@ def attention_reference(
     return jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
 
 
+def _flash_min_seq() -> int:
+    """Sequence length above which the Pallas flash kernel dispatches.
+
+    Below it, XLA's own fused attention is FASTER on TPU (measured on-chip:
+    vit_b16 S=197 runs 20.3ms/step via XLA vs 29.1ms via flash,
+    BENCH_NOTES.md round 2) — the S^2 score tensor is small enough that
+    fusion beats tiling, so flash only pays off where it was designed to:
+    long sequences whose S^2 intermediates would blow HBM traffic/VMEM
+    (and the ring-attention SP path, which calls it directly)."""
+    import os
+
+    return int(os.environ.get("STORM_TPU_FLASH_MIN_SEQ", "1024"))
+
+
 def scaled_dot_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: Optional[float] = None
 ) -> jnp.ndarray:
-    """Dispatch: Pallas flash attention on TPU, reference path elsewhere."""
-    if _use_pallas():
+    """Dispatch: Pallas flash attention on TPU for long sequences, XLA's
+    fused attention otherwise (shape-aware — see :func:`_flash_min_seq`)."""
+    if _use_pallas() and q.shape[-2] >= _flash_min_seq():
         from storm_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, scale=scale)

@@ -105,11 +105,24 @@ def _reference(x2, r2, g, b, eps):
     return y, layernorm({"scale": g, "bias": b}, y, eps)
 
 
+def _force_pallas_norm() -> bool:
+    """The Pallas residual+LN kernel is OFF by default: measured on-chip
+    (BENCH_NOTES.md round 2 A/B) XLA's own elementwise fusion wins —
+    vit_b16 19.3ms/step XLA vs 20.4ms with the kernel, mixer_tiny 0.33ms
+    vs 0.60ms. XLA already emits one fused pass for add+LN; the hand
+    kernel only adds pipeline barriers. ``STORM_TPU_FUSED_NORM=1``
+    re-enables it (e.g. to re-measure on a future XLA/TPU generation)."""
+    import os
+
+    return os.environ.get("STORM_TPU_FUSED_NORM", "") not in (
+        "", "0", "false", "False")
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _fused(x2, r2, g, b, eps):
     from storm_tpu.ops.platform import use_pallas
 
-    if use_pallas():
+    if use_pallas() and _force_pallas_norm():
         return _fused_fwd_pallas(x2, r2, g, b, eps=eps)
     return _reference(x2, r2, g, b, eps)
 

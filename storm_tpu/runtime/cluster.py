@@ -593,6 +593,15 @@ class LocalCluster:
 
         return self._run(snap())
 
+    def reset_histogram(self, name: str, component: str, metric: str) -> None:
+        """Clear one histogram's reservoir (bench harness: drop calibration
+        traffic so the measured window starts clean)."""
+        async def reset():
+            self._cluster.runtime(name).metrics.histogram(
+                component, metric).reset()
+
+        self._run(reset())
+
     def errors(self, name: str) -> List[Tup[str, int, BaseException]]:
         async def errs():
             return list(self._cluster.runtime(name).errors)
@@ -600,6 +609,11 @@ class LocalCluster:
         return self._run(errs())
 
     def shutdown(self) -> None:
+        # Idempotent: callers wrap work in try/finally shutdown AND call it
+        # on the happy path; the second call must not touch the dead loop.
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         self._run(self._cluster.shutdown())
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=10)

@@ -60,6 +60,14 @@ class Histogram:
             return float("nan")
         return float(np.percentile(self._buf[: self._n], q))
 
+    def reset(self) -> None:
+        """Drop the reservoir and counters (bench harness: discard probe /
+        calibration traffic so the measured window starts clean)."""
+        self._n = 0
+        self._i = 0
+        self.count = 0
+        self.sum = 0.0
+
     @property
     def mean(self) -> float:
         return self.sum / self.count if self.count else float("nan")

@@ -1,0 +1,31 @@
+"""Regenerate the JVM-boundary golden fixtures (run from the repo root).
+
+The fixtures freeze the exact bytes the /storm_tpu.Inference/Predict
+boundary ships: an Arrow IPC tensor request (N,H,W,C f32) and response
+(N,K f32), as emitted by the production C++ marshaller
+(storm_tpu/native/arrow_tensor.cpp). A JVM implementer validates their
+Arrow writer/reader against these without running Python — see
+docs/JVM_CLIENT.md.
+"""
+import numpy as np
+
+from storm_tpu.serve.marshal import encode_tensor
+
+def request_array() -> np.ndarray:
+    # 2 MNIST-shaped instances, deterministic ramp (not random: the byte
+    # pattern must be reproducible from the formula in the docs alone)
+    n = 2 * 28 * 28 * 1
+    return (np.arange(n, dtype=np.float32) / n).reshape(2, 28, 28, 1)
+
+def response_array() -> np.ndarray:
+    # 2 softmax-like rows over 10 classes: row i = softmax(arange(10)+i)
+    z = np.stack([np.arange(10, dtype=np.float32) + i for i in range(2)])
+    e = np.exp(z - z.max(axis=1, keepdims=True))
+    return (e / e.sum(axis=1, keepdims=True)).astype(np.float32)
+
+if __name__ == "__main__":
+    import pathlib
+    here = pathlib.Path(__file__).parent
+    (here / "predict_request.arrow").write_bytes(encode_tensor(request_array()))
+    (here / "predict_response.arrow").write_bytes(encode_tensor(response_array()))
+    print("wrote", *[p.name for p in here.glob("*.arrow")])

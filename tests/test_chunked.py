@@ -6,6 +6,7 @@ import asyncio
 import json
 
 import numpy as np
+import pytest
 
 from storm_tpu.api.schema import decode_predictions
 from storm_tpu.config import BatchConfig, Config, ModelConfig, OffsetsConfig, ShardingConfig
@@ -64,6 +65,7 @@ async def _run_chunked(n_msgs, poison_at=None, chunk=4):
     return outs, dlq, snap
 
 
+@pytest.mark.slow
 def test_chunked_ingestion_end_to_end(run):
     outs, dlq, snap = run(_run_chunked(n_msgs=25, chunk=4), timeout=120)
     assert len(outs) == 25 and len(dlq) == 0

@@ -634,3 +634,21 @@ def test_transactional_sink_rearms_deadline_after_own_flush(run):
         await cluster.shutdown()
 
     run(main(), timeout=30)
+
+
+def test_append_root_ts_clamps_future_timestamps():
+    """A producer with a skewed-forward clock must not yield negative
+    latency: the ingress clock clamps record age at 0."""
+    import time as _time
+
+    from storm_tpu.connectors.memory import Record
+    from storm_tpu.connectors.spout import BrokerSpout
+
+    spout = object.__new__(BrokerSpout)  # _append_root_ts reads no state
+    now = _time.perf_counter()
+    past = Record("t", 0, 0, None, b"v", _time.time() - 1.5)
+    future = Record("t", 0, 1, None, b"v", _time.time() + 60.0)
+    ts_past = spout._append_root_ts(past)
+    ts_future = spout._append_root_ts(future)
+    assert 1.3 <= now - ts_past <= 1.8  # ~1.5s of age preserved
+    assert ts_future <= _time.perf_counter()  # clamped, never negative age

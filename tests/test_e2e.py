@@ -140,3 +140,47 @@ def test_standard_topology_spout_chunk_config(run):
         await cluster.shutdown()
 
     run(go(), timeout=60)
+
+
+def test_e2e_latency_clock_starts_at_broker_append(run):
+    """The north-star latency metric is append->deliver (BASELINE.md): a
+    record that sat in the log before the spout fetched it must carry that
+    queueing in the sink's e2e histogram. Round 1 started the clock at
+    spout emit (spout.py:273), hiding broker-side delay entirely."""
+
+    async def main():
+        broker = MemoryBroker(default_partitions=1)
+        cfg = Config()
+        model_cfg = ModelConfig(name="lenet5", dtype="float32",
+                                input_shape=(28, 28, 1))
+        tb = TopologyBuilder()
+        tb.set_spout("spout", BrokerSpout(
+            broker, "input",
+            OffsetsConfig(policy="earliest", max_behind=None)), 1)
+        tb.set_bolt("infer", InferenceBolt(
+            model_cfg, BatchConfig(max_batch=4, max_wait_ms=5, buckets=(4,)),
+            ShardingConfig(data_parallel=0), warmup=False), 1)\
+            .shuffle_grouping("spout")
+        tb.set_bolt("sink", BrokerSink(broker, "output", cfg.sink), 1)\
+            .shuffle_grouping("infer")
+
+        # Produce BEFORE the topology exists: the record ages in the log.
+        broker.produce("input", _payload())
+        await asyncio.sleep(0.4)
+
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("clock", cfg, tb.build())
+        deadline = asyncio.get_event_loop().time() + 30
+        while asyncio.get_event_loop().time() < deadline:
+            if broker.topic_size("output") >= 1:
+                break
+            await asyncio.sleep(0.02)
+        await rt.drain(timeout_s=10)
+        lat = rt.metrics.snapshot()["sink"]["e2e_latency_ms"]
+        await cluster.shutdown()
+        # >= the 400ms the record aged pre-submit (plus pipeline time).
+        assert lat["count"] >= 1
+        assert lat["p50"] >= 400, lat
+        return lat
+
+    run(main(), timeout=90)

@@ -259,6 +259,7 @@ def test_int8_weights_bf16_keeps_compute_dtype():
             assert leaf.dtype != jnp.float32, "f32 leaf would promote activations"
 
 
+@pytest.mark.slow
 def test_int8_fused_matches_int8():
     """"int8_fused" (Pallas fused dequant-matmul on TPU; jnp fallback here)
     quantizes identically to "int8" — outputs must agree tightly on a
@@ -282,6 +283,7 @@ def test_int8_fused_matches_int8():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_int8_fused_moe_model_runs():
     """Regression: the keep-dense predicate must be path-based — MoE params
     (2-D gate/biases consumed as raw arrays, not via layers.dense) crashed

@@ -39,6 +39,7 @@ def test_lenet_deterministic_init():
     assert jax.tree.all(jax.tree.map(lambda a, b: bool(jnp.all(a == b)), p1, p2))
 
 
+@pytest.mark.slow
 def test_resnet20_shapes():
     model, logits, *_ = _fwd("resnet20")
     assert logits.shape == (2, 10)
@@ -58,6 +59,7 @@ def test_resnet20_train_updates_bn_state():
     assert bool(jnp.all(same_state["stem"]["bn"]["mean"] == stem_before))
 
 
+@pytest.mark.slow
 def test_resnet50_small_input():
     # Same code path as ImageNet config, smaller spatial dims for CI speed.
     model, logits, *_ = _fwd("resnet50", num_classes=100, input_shape=(64, 64, 3))
@@ -76,6 +78,7 @@ def test_vit_patch_divisibility():
         build_model("vit_tiny", input_shape=(30, 30, 3))
 
 
+@pytest.mark.slow
 def test_vit_b16_param_count():
     """ViT-B/16 has ~86M params — structural check against the standard
     architecture (12 layers, dim 768, heads 12, mlp 3072)."""
@@ -147,6 +150,7 @@ def test_moe_vit_serves_through_engine():
     np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_mobilenetv2_shapes_cifar():
     model, logits, *_ = _fwd("mobilenetv2", num_classes=10,
                              input_shape=(32, 32, 3), width=0.5)

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from storm_tpu.ops import layers as L
 from storm_tpu.ops.attention import attention_reference, mha_init, multi_head_attention
@@ -67,6 +68,7 @@ def test_mha_shapes():
     assert y.shape == (2, 10, 32)
 
 
+@pytest.mark.slow
 def test_flash_attention_matches_reference_interpret():
     """Pallas kernel (interpreter on CPU) vs the jnp reference path —
     includes the ViT-B/16 shape (197 padded) and a multi-KV-chunk case."""
@@ -137,6 +139,7 @@ def test_fused_residual_layernorm_kernel_matches_reference():
         np.testing.assert_allclose(np.asarray(go), np.asarray(wo), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_fused_residual_layernorm_grads():
     """custom_vjp backward must match autodiff through the unfused ops —
     the training path (pjit/pipeline dryruns) differentiates blocks that

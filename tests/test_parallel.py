@@ -46,6 +46,7 @@ def test_tp_param_placement():
     assert ln_spec == P()
 
 
+@pytest.mark.slow
 def test_sharded_train_step_matches_single_device():
     """dp x tp sharded step computes the same loss as unsharded."""
     model = build_model("vit_tiny")
@@ -64,6 +65,7 @@ def test_sharded_train_step_matches_single_device():
     np.testing.assert_allclose(float(loss_sharded), float(loss_single), rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_train_reduces_loss_over_steps():
     model = build_model("vit_tiny")
     mesh = make_mesh(8, 1)
@@ -103,6 +105,7 @@ def test_ring_attention_rejects_indivisible():
         ring_attention(q, q, q, mesh)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_contract():
     import __graft_entry__ as g
 

@@ -206,3 +206,93 @@ def test_cross_caller_batcher_propagates_errors():
     b = CrossCallerBatcher(BoomEngine(), window_ms=1.0)
     with pytest.raises(RuntimeError, match="boom"):
         b.predict(np.zeros((2, 2), np.float32))
+
+
+# ---- JVM-boundary conformance (VERDICT r1 next #7) ---------------------------
+
+
+def test_jvm_conformance_golden_fixtures():
+    """The checked-in golden bytes for /storm_tpu.Inference/Predict must
+    (a) decode through OUR stack to the documented arrays, (b) be accepted
+    by an independent Arrow implementation (pyarrow, standing in for the
+    Arrow Java reader a Storm bolt would use), and (c) be reproduced
+    byte-for-byte by the production C++ marshaller — so a third party can
+    implement InferenceBolt.java:80-86 against the service from the docs
+    and fixtures alone (docs/JVM_CLIENT.md)."""
+    import pathlib
+
+    import numpy as np
+
+    from storm_tpu.serve.marshal import decode_tensor, encode_tensor
+    from tests.fixtures.jvm_conformance.generate import (request_array,
+                                                         response_array)
+
+    here = pathlib.Path(__file__).parent / "fixtures" / "jvm_conformance"
+    req = (here / "predict_request.arrow").read_bytes()
+    resp = (here / "predict_response.arrow").read_bytes()
+
+    # (a) our decoder
+    x = decode_tensor(req)
+    assert x.shape == (2, 28, 28, 1) and x.dtype == np.float32
+    np.testing.assert_array_equal(x, request_array())
+    y = decode_tensor(resp)
+    assert y.shape == (2, 10) and y.dtype == np.float32
+    np.testing.assert_array_equal(y, response_array())
+    np.testing.assert_allclose(y.sum(axis=1), 1.0, atol=1e-5)
+
+    # (b) independent Arrow reader accepts our wire bytes
+    pa = pytest.importorskip("pyarrow")
+    np.testing.assert_array_equal(
+        pa.ipc.read_tensor(pa.py_buffer(req)).to_numpy(), request_array())
+    np.testing.assert_array_equal(
+        pa.ipc.read_tensor(pa.py_buffer(resp)).to_numpy(), response_array())
+
+    # (c) our encoder reproduces the fixtures exactly (wire determinism);
+    # meaningful only on the production C++ path — the pyarrow fallback is
+    # wire-compatible but not byte-identical (flatbuffer field order).
+    from storm_tpu.native import encode_tensor_native
+
+    if encode_tensor_native(request_array()) is not None:
+        assert encode_tensor(request_array()) == req
+        assert encode_tensor(response_array()) == resp
+
+
+def test_jvm_conformance_service_end_to_end():
+    """A 'JVM client' (pyarrow-encoded request, as Arrow Java would emit)
+    calls the live Predict service; the response decodes with pyarrow and
+    matches the engine's own output — the full north-star boundary."""
+    pa = pytest.importorskip("pyarrow")
+    import numpy as np
+
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.serve.worker import InferenceWorker
+    from tests.fixtures.jvm_conformance.generate import request_array
+
+    worker = InferenceWorker(
+        ModelConfig(name="lenet5", dtype="float32", input_shape=(28, 28, 1)),
+        ShardingConfig(data_parallel=0),
+        BatchConfig(max_batch=8, buckets=(8,)),
+        port=0,
+    )
+    worker.start()
+    try:
+        import grpc
+
+        # encode the request like a JVM Arrow writer (NOT our marshaller)
+        sink = pa.BufferOutputStream()
+        pa.ipc.write_tensor(pa.Tensor.from_numpy(request_array()), sink)
+        req = sink.getvalue().to_pybytes()
+        chan = grpc.insecure_channel(f"127.0.0.1:{worker.port}")
+        out = chan.unary_unary(
+            "/storm_tpu.Inference/Predict",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )(req)
+        y = pa.ipc.read_tensor(pa.py_buffer(out)).to_numpy()
+        assert y.shape == (2, 10)
+        np.testing.assert_allclose(y.sum(axis=1), 1.0, atol=1e-4)
+        want = worker.engine.predict(request_array())
+        np.testing.assert_allclose(y, want, atol=1e-5)
+        chan.close()
+    finally:
+        worker.stop()

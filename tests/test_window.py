@@ -78,6 +78,7 @@ async def _run_windowed(items, bolt, settled=None, timeout=30.0):
     return res
 
 
+@pytest.mark.slow
 def test_tumbling_count_windows(run):
     CollectWindows.windows = None
     items = [f"m{i}" for i in range(10)]
@@ -92,6 +93,7 @@ def test_tumbling_count_windows(run):
     assert failed == []
 
 
+@pytest.mark.slow
 def test_sliding_count_windows(run):
     CollectWindows.windows = None
     items = [f"m{i}" for i in range(6)]
