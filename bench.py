@@ -353,8 +353,15 @@ def run_latency_phase(produce_nth, out_size_fn, reset_hists, read_lat,
     for i in range(probe):
         produce_nth(i)
     if not await_outputs(lambda: out_size_fn() - base, probe, grace_s=180.0):
+        # No measurement without a clean start: probe stragglers delivered
+        # during attempt 1 would disarm the backlog guard (negative
+        # backlog), fake the drain check, and pollute the reset histogram
+        # with ~minutes-old latencies — reported as valid. Bail out.
         done = out_size_fn() - base
-        log(f"  calibration probe incomplete: {done}/{probe}")
+        log(f"  calibration probe incomplete ({done}/{probe}); "
+            "latency phase INVALID")
+        p50, p99 = read_lat()
+        return p50, p99, 0.0, False
     cap = max(out_size_fn() - base, 1) / (time.perf_counter() - t0)
     rate = max(4.0, cap * headroom)
     log(f"  calibrated latency-topology capacity ~{cap:.0f} msg/s "
@@ -406,7 +413,6 @@ def run_autoscale(args) -> dict:
 
     from storm_tpu.config import BatchConfig
     from storm_tpu.connectors import MemoryBroker
-    from storm_tpu.runtime.autoscale import AutoscalePolicy, Autoscaler
     from storm_tpu.runtime.cluster import LocalCluster
 
     cfg = dict(CONFIGS[args.config])
@@ -462,7 +468,11 @@ def _run_autoscale_inner(args, cfg, cluster, broker, payloads, n_dev,
     t0 = time.perf_counter()
     for i in range(probe):
         broker.produce("input", payloads[i % len(payloads)])
-    await_outputs(lambda: broker.topic_size("output"), probe, grace_s=180.0)
+    if not await_outputs(lambda: broker.topic_size("output"), probe,
+                         grace_s=180.0):
+        # Probe stragglers delivering into the ramp would carry stale
+        # latencies into the histogram and spuriously trip the autoscaler.
+        sys.exit("autoscale probe never drained; system unhealthy")
     cap1 = max(broker.topic_size("output"), 1) / (time.perf_counter() - t0)
     log(f"parallelism-1 capacity ~{cap1:.0f} msg/s; SLO p50 <= {slo_ms:.0f} ms")
     cluster.reset_histogram("bench-slo", "kafka-bolt", "e2e_latency_ms")
@@ -627,21 +637,37 @@ def main() -> None:
         return
     if args.all:
         results = []
-        for name in ("lenet5", "resnet20", "mobilenetv2", "mixer_tiny",
-                     "resnet50", "vit_b16", "multi"):
-            log(f"===== --all: {name} =====")
+        matrix = [
+            ("lenet5", {}),
+            ("resnet20", {}),
+            # wire + weight quantization variants on the headline config
+            ("resnet20", {"transfer_dtype": "uint8"}),
+            ("resnet20", {"weights": "int8"}),
+            ("mobilenetv2", {}),
+            ("mixer_tiny", {}),
+            ("resnet50", {}),
+            ("vit_b16", {}),
+            ("multi", {}),
+        ]
+        for name, overrides in matrix:
+            label = name + "".join(f"+{v}" for v in overrides.values())
+            log(f"===== --all: {label} =====")
             a = argparse.Namespace(**vars(args))
             a.config = name
+            for k, v in overrides.items():
+                setattr(a, k, v)
             if name in ("resnet50", "vit_b16"):
                 # 224x224 JSON is ~50 img/s through the tunnel (BENCH_NOTES
                 # r1); keep the wall time bounded.
                 a.messages = min(args.messages, 512)
             try:
-                results.append(run_multi(a) if name == "multi"
-                               else run_single(a))
+                r = run_multi(a) if name == "multi" else run_single(a)
+                if overrides:
+                    r["config"] = label
+                results.append(r)
             except Exception as e:  # keep the matrix going; record the hole
-                log(f"--all config {name} FAILED: {e!r}")
-                results.append({"config": name, "error": repr(e)})
+                log(f"--all config {label} FAILED: {e!r}")
+                results.append({"config": label, "error": repr(e)})
         print(json.dumps(results))
         return
     result = run_multi(args) if args.config == "multi" else run_single(args)

@@ -47,6 +47,10 @@ CONFIGS = {
                      num_classes=1000, batch=64),
     "vit_b16": dict(model="vit_b16", input_shape=(224, 224, 3),
                     num_classes=1000, batch=64),
+    # Long-context serving config: S=2048 dispatches the Pallas flash
+    # kernel in the real engine path (past the measured crossover).
+    "longseq_encoder": dict(model="longseq_encoder", input_shape=(2048, 64),
+                            num_classes=10, batch=8),
 }
 
 

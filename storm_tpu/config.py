@@ -116,6 +116,12 @@ class ShardingConfig:
 
     data_parallel: int = 1  # dp axis size (0 = use all available devices)
     tensor_parallel: int = 1  # tp axis size (param sharding)
+    # sp axis size: shard the SEQUENCE axis of long-context models across
+    # chips (ring attention over ICI) — for sequences whose activations
+    # exceed one chip. Only models publishing ``apply_sp`` (e.g.
+    # longseq_encoder) can serve with sp > 1; mutually exclusive with
+    # tensor_parallel for serving.
+    sequence_parallel: int = 1
     axis_names: tuple = ("data", "model")
 
 

@@ -286,7 +286,11 @@ def encode_record_batch(
     elif compression == "snappy":
         from storm_tpu.connectors import snappy as _snappy
 
-        payload = _snappy.compress(payload)
+        # xerial framing: Kafka's Java stack (broker record validation AND
+        # consumers) decompresses snappy via SnappyInputStream, which
+        # requires the \x82SNAPPY\x00 stream header — in the record-batch
+        # era too, not just v0/v1 wrapper messages.
+        payload = _snappy.compress(payload, xerial=True)
         attrs |= 2  # codec bits: snappy
     after_crc = Writer()
     after_crc.i16(attrs)
@@ -351,8 +355,9 @@ def decode_record_batch(topic: str, partition: int, data: bytes,
     elif codec == 2:
         from storm_tpu.connectors.snappy import decompress as _snappy
 
-        # magic-2 batches carry a raw snappy block (xerial framing is
-        # message-set-era; decompress() sniffs either, defensively).
+        # snappy-java frames record batches xerially too; decompress()
+        # sniffs the header and accepts raw blocks as well (non-Java
+        # producers sometimes ship them).
         payload = _snappy(payload)
     elif codec != 0:
         raise KafkaProtocolError(

@@ -24,6 +24,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
 from storm_tpu.models.registry import ModelDef, build_model, load_or_init
@@ -135,12 +136,32 @@ class InferenceEngine:
             **getattr(model_cfg, "extra", {}),
         )
         self.dtype = jnp.dtype(model_cfg.dtype)
+        # Sequence-parallel serving: mesh is (data, seq) and the model must
+        # publish an SP-aware forward (ring attention; the full sequence
+        # never materializes on one chip). Long-context first-class —
+        # the reference's fixed 4-D image tensors have no sequence axis.
+        self.sp = int(getattr(self.sharding_cfg, "sequence_parallel", 1))
+        if self.sp > 1:
+            if self.model.apply_sp is None:
+                raise ValueError(
+                    f"model {model_cfg.name!r} has no apply_sp; "
+                    "sequence_parallel > 1 needs an SP-aware family "
+                    "(e.g. longseq_encoder)")
+            if self.sharding_cfg.tensor_parallel > 1:
+                raise ValueError(
+                    "sequence_parallel and tensor_parallel are mutually "
+                    "exclusive for serving")
+            if self.model.input_shape[0] % self.sp:
+                raise ValueError(
+                    f"sequence {self.model.input_shape[0]} not divisible "
+                    f"by sequence_parallel={self.sp}")
         self.mesh = mesh if mesh is not None else make_mesh(
             self.sharding_cfg.data_parallel,
-            self.sharding_cfg.tensor_parallel,
-            self.sharding_cfg.axis_names,
+            self.sp if self.sp > 1 else self.sharding_cfg.tensor_parallel,
+            ("data", "seq") if self.sp > 1 else self.sharding_cfg.axis_names,
         )
-        self.data_axis = self.sharding_cfg.axis_names[0]
+        self.data_axis = ("data" if self.sp > 1
+                          else self.sharding_cfg.axis_names[0])
         self._lock = threading.Lock()
 
         params, state = load_or_init(self.model, model_cfg.checkpoint, model_cfg.seed)
@@ -189,23 +210,35 @@ class InferenceEngine:
         p_shardings = jax.tree.map(lambda a: a.sharding, self.params)
 
         apply = self.model.apply
-        x_shard = batch_sharding(self.mesh, self.data_axis)
+        apply_sp = self.model.apply_sp
+        out_shard = batch_sharding(self.mesh, self.data_axis)
+        if self.sp > 1:
+            # inputs (N, S, ...): batch over data, sequence over seq
+            x_shard = NamedSharding(self.mesh, P(self.data_axis, "seq"))
+        else:
+            x_shard = out_shard
         dtype = self.dtype
         w8 = self._w8
 
         w8_fused = self._w8_fused
+        sp = self.sp
+        mesh_ref = self.mesh
 
         def fwd(params, state, x):
             if w8:
                 params = dequantize_params(params, dtype, keep_dense=w8_fused)
-            logits, _ = apply(params, state, x, train=False)
+            if sp > 1:
+                logits, _ = apply_sp(params, state, x, mesh_ref, "seq",
+                                     train=False)
+            else:
+                logits, _ = apply(params, state, x, train=False)
             logits = logits.astype(jnp.float32)
             return jax.nn.softmax(logits, axis=-1) if softmax else logits
 
         self._fwd = jax.jit(
             fwd,
             in_shardings=(p_shardings, replicated(self.mesh), x_shard),
-            out_shardings=x_shard,
+            out_shardings=out_shard,
         )
         # uint8 transfer path: the wire carries affine-quantized bytes plus a
         # per-batch (scale, offset); dequantization runs on device inside the
@@ -225,7 +258,7 @@ class InferenceEngine:
                 replicated(self.mesh),
                 replicated(self.mesh),
             ),
-            out_shardings=x_shard,
+            out_shardings=out_shard,
         )
         self._x_sharding = x_shard
         self._scalar_sharding = replicated(self.mesh)
@@ -366,7 +399,8 @@ def shared_engine(
         # must not share one cached engine); deep-freeze so TOML-sourced
         # list values stay hashable
         _freeze(getattr(model_cfg, "extra", {})),
-        (sharding_cfg.data_parallel, sharding_cfg.tensor_parallel)
+        (sharding_cfg.data_parallel, sharding_cfg.tensor_parallel,
+         getattr(sharding_cfg, "sequence_parallel", 1))
         if sharding_cfg
         else None,
         # Batch policy is part of the identity: pad_batch/warmup read the
@@ -401,7 +435,6 @@ def shared_engine(
     try:
         with _ENGINES_LOCK:
             _ENGINES[key] = engine
-            _BUILDS.pop(key, None)
             try:
                 _evict_to_budget_locked(keep=key)
                 _log_hbm_inventory()
@@ -411,8 +444,12 @@ def shared_engine(
                 # eviction or the inventory log hiccuped.
                 logger.exception("engine cache bookkeeping failed")
     finally:
-        # ALWAYS resolve — even on BaseException (KeyboardInterrupt) —
-        # or waiters parked on fut.result() (no timeout) hang forever.
+        # ALWAYS clear the in-progress entry and resolve — even on
+        # BaseException (KeyboardInterrupt while acquiring the lock).
+        # A stale _BUILDS future would serve the engine forever while
+        # keeping it invisible to the cache/eviction/inventory; an
+        # unresolved future would hang waiters (no timeout) permanently.
+        _BUILDS.pop(key, None)
         fut.set_result(engine)
     return engine
 

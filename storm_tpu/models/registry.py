@@ -32,6 +32,11 @@ class ModelDef:
     init: Callable[[jax.Array], Tuple[Any, Any]]
     apply: Callable[..., Tuple[jnp.ndarray, Any]]
     flagship: bool = False
+    # Optional sequence-parallel forward for long-context serving:
+    # ``apply_sp(params, state, x, mesh, seq_axis, train=False)`` runs with
+    # the S axis of ``x`` sharded over ``seq_axis`` (ring attention), never
+    # materializing the full sequence on one chip. None = SP-unaware.
+    apply_sp: Any = None
 
 
 _BUILDERS: Dict[str, Callable[..., ModelDef]] = {}
@@ -47,7 +52,15 @@ def register(name: str) -> Callable:
 
 def _load_builtin() -> None:
     # Import model modules lazily so registration happens on demand.
-    from storm_tpu.models import lenet, mixer, mobilenet, moe_vit, resnet, vit  # noqa: F401
+    from storm_tpu.models import (  # noqa: F401
+        lenet,
+        longseq,
+        mixer,
+        mobilenet,
+        moe_vit,
+        resnet,
+        vit,
+    )
 
 
 def registry_names() -> list:

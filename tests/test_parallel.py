@@ -192,3 +192,59 @@ def test_serving_engine_tp_with_int8_weights():
     got, want = tp.predict(x), rep.predict(x)
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
     assert tp.param_bytes_per_device() < rep.param_bytes_per_device()
+
+
+# ---- sequence parallelism in the SERVING engine ------------------------------
+
+
+def test_serving_engine_sp_matches_dense():
+    """sequence_parallel=4: the engine serves the long-context family with
+    the S axis sharded over a (data, seq) mesh (ring attention on the
+    'ICI'); outputs match the dense single-mesh engine."""
+    import numpy as np
+
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.infer.engine import InferenceEngine
+
+    mcfg = ModelConfig(name="longseq_tiny", dtype="float32",
+                       input_shape=(64, 16), seed=3)
+    bcfg = BatchConfig(max_batch=4, buckets=(4,))
+    dense = InferenceEngine(mcfg, ShardingConfig(data_parallel=0), bcfg)
+    sp = InferenceEngine(
+        mcfg, ShardingConfig(data_parallel=2, sequence_parallel=4), bcfg)
+    assert sp.sp == 4
+    assert dict(sp.mesh.shape) == {"data": 2, "seq": 4}
+
+    x = np.random.RandomState(0).rand(4, 64, 16).astype(np.float32)
+    want = dense.predict(x)
+    got = sp.predict(x)
+    assert got.shape == want.shape == (4, 10)
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
+    np.testing.assert_allclose(got.sum(-1), np.ones(4), atol=1e-4)
+
+
+def test_serving_engine_sp_rejects_unsupported():
+    """SP serving needs an SP-aware model, sp x tp is rejected, and the
+    sequence must divide by sp."""
+    import pytest as _pytest
+
+    from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
+    from storm_tpu.infer.engine import InferenceEngine
+
+    bcfg = BatchConfig(max_batch=4, buckets=(4,))
+    with _pytest.raises(ValueError, match="apply_sp"):
+        InferenceEngine(
+            ModelConfig(name="lenet5", dtype="float32",
+                        input_shape=(28, 28, 1)),
+            ShardingConfig(data_parallel=2, sequence_parallel=4), bcfg)
+    with _pytest.raises(ValueError, match="mutually exclusive"):
+        InferenceEngine(
+            ModelConfig(name="longseq_tiny", dtype="float32",
+                        input_shape=(64, 16)),
+            ShardingConfig(data_parallel=2, sequence_parallel=2,
+                           tensor_parallel=2), bcfg)
+    with _pytest.raises(ValueError, match="not divisible"):
+        InferenceEngine(
+            ModelConfig(name="longseq_tiny", dtype="float32",
+                        input_shape=(63, 16)),
+            ShardingConfig(data_parallel=1, sequence_parallel=4), bcfg)
