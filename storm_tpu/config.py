@@ -122,6 +122,10 @@ class ShardingConfig:
     # longseq_encoder) can serve with sp > 1; mutually exclusive with
     # tensor_parallel for serving.
     sequence_parallel: int = 1
+    # ep axis size: shard MoE expert tensors over chips for serving (the
+    # routing einsums lower to all-to-alls). Only meaningful for MoE
+    # families; mutually exclusive with tp/sp for serving.
+    expert_parallel: int = 1
     axis_names: tuple = ("data", "model")
 
 

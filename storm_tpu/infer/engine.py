@@ -32,6 +32,7 @@ from storm_tpu.parallel.mesh import make_mesh
 from storm_tpu.parallel.sharding import (
     batch_sharding,
     replicated,
+    shard_params_ep,
     shard_params_tp,
 )
 
@@ -136,35 +137,67 @@ class InferenceEngine:
             **getattr(model_cfg, "extra", {}),
         )
         self.dtype = jnp.dtype(model_cfg.dtype)
-        # Sequence-parallel serving: mesh is (data, seq) and the model must
-        # publish an SP-aware forward (ring attention; the full sequence
-        # never materializes on one chip). Long-context first-class —
-        # the reference's fixed 4-D image tensors have no sequence axis.
+        # Serving parallelism beyond DP: at most ONE of tp/sp/ep sizes the
+        # mesh's second axis (composing them needs a 3D mesh — train-side
+        # territory; serving keeps one knob per engine).
+        #   tp — Megatron param sharding ("model" axis);
+        #   sp — sequence axis sharded, ring attention ("seq" axis; needs
+        #        an SP-aware model forward, ModelDef.apply_sp);
+        #   ep — MoE expert tensors sharded ("expert" axis; apply is
+        #        unchanged, GSPMD lowers dispatch/combine to all-to-alls).
         self.sp = int(getattr(self.sharding_cfg, "sequence_parallel", 1))
+        self.ep = int(getattr(self.sharding_cfg, "expert_parallel", 1))
+        tp_req = int(self.sharding_cfg.tensor_parallel)
+        if sum(x > 1 for x in (tp_req, self.sp, self.ep)) > 1:
+            raise ValueError(
+                "tensor_parallel, sequence_parallel, and expert_parallel "
+                "are mutually exclusive for serving")
         if self.sp > 1:
             if self.model.apply_sp is None:
                 raise ValueError(
                     f"model {model_cfg.name!r} has no apply_sp; "
                     "sequence_parallel > 1 needs an SP-aware family "
                     "(e.g. longseq_encoder)")
-            if self.sharding_cfg.tensor_parallel > 1:
-                raise ValueError(
-                    "sequence_parallel and tensor_parallel are mutually "
-                    "exclusive for serving")
             if self.model.input_shape[0] % self.sp:
                 raise ValueError(
                     f"sequence {self.model.input_shape[0]} not divisible "
                     f"by sequence_parallel={self.sp}")
+        if self.sp > 1:
+            axis2, size2 = "seq", self.sp
+        elif self.ep > 1:
+            axis2, size2 = "expert", self.ep
+        else:
+            axis2, size2 = None, tp_req
         self.mesh = mesh if mesh is not None else make_mesh(
             self.sharding_cfg.data_parallel,
-            self.sp if self.sp > 1 else self.sharding_cfg.tensor_parallel,
-            ("data", "seq") if self.sp > 1 else self.sharding_cfg.axis_names,
+            size2,
+            ("data", axis2) if axis2 else self.sharding_cfg.axis_names,
         )
-        self.data_axis = ("data" if self.sp > 1
+        self.data_axis = ("data" if axis2
                           else self.sharding_cfg.axis_names[0])
         self._lock = threading.Lock()
 
         params, state = load_or_init(self.model, model_cfg.checkpoint, model_cfg.seed)
+        if self.ep > 1:
+            # Fail loudly on misconfig — silent full replication across an
+            # expert mesh would burn ep-fold HBM/compute while the user
+            # believes experts are sharded.
+            expert_dims = [
+                leaf.shape[0]
+                for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    params)[0]
+                if "moe" in [getattr(k, "key", None) for k in path]
+                and getattr(leaf, "ndim", 0) == 3
+            ]
+            if not expert_dims:
+                raise ValueError(
+                    f"model {model_cfg.name!r} has no MoE params; "
+                    "expert_parallel > 1 needs an MoE family "
+                    "(e.g. moe_vit_tiny)")
+            if any(e % self.ep for e in expert_dims):
+                raise ValueError(
+                    f"n_experts {set(expert_dims)} not divisible by "
+                    f"expert_parallel={self.ep}")
         cast = lambda t: jax.tree.map(
             lambda a: a.astype(self.dtype) if a.dtype == jnp.float32 else a, t
         )
@@ -182,6 +215,8 @@ class InferenceEngine:
         if self.tp > 1:
             place_params = lambda t: shard_params_tp(
                 self.mesh, t, self.model_axis)
+        elif self.ep > 1:
+            place_params = lambda t: shard_params_ep(self.mesh, t, "expert")
         else:
             place_params = lambda t: jax.device_put(t, replicated(self.mesh))
         # BN statistics stay f32 (cast only f32 leaves to compute dtype would
@@ -400,7 +435,8 @@ def shared_engine(
         # list values stay hashable
         _freeze(getattr(model_cfg, "extra", {})),
         (sharding_cfg.data_parallel, sharding_cfg.tensor_parallel,
-         getattr(sharding_cfg, "sequence_parallel", 1))
+         getattr(sharding_cfg, "sequence_parallel", 1),
+         getattr(sharding_cfg, "expert_parallel", 1))
         if sharding_cfg
         else None,
         # Batch policy is part of the identity: pad_batch/warmup read the

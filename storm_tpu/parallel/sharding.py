@@ -66,6 +66,41 @@ def shard_params_tp(
     return jax.tree_util.tree_unflatten(treedef, placed)
 
 
+def shard_params_ep(
+    mesh: Mesh, params: Any, expert_axis: str = "expert"
+) -> Any:
+    """Expert-parallel placement for serving: MoE expert tensors (leading
+    axis = experts; ``moe`` subtree keys ``w_in``/``b_in``/``w_out``/
+    ``b_out``, see parallel/moe.py moe_init) shard their expert dim over
+    ``expert_axis``; the router gate and every non-MoE param replicate.
+    The model's apply is UNCHANGED — GSPMD lowers the dispatch/combine
+    einsums to all-to-alls around the sharded expert matmuls."""
+    from storm_tpu.parallel.moe import moe_param_specs
+
+    # One source of truth with the train-side helpers: every moe param
+    # whose spec names the expert axis shards its leading (expert) dim.
+    expert_keys = {
+        k for k, spec in moe_param_specs(expert_axis).items()
+        if expert_axis in (spec or ())
+    }
+
+    def spec_for(path: tuple, leaf) -> NamedSharding:
+        keys = [getattr(k, "key", getattr(k, "idx", None)) for k in path]
+        # Match the expert key ANYWHERE in the path, not just last: int8
+        # quantization rewraps weights as {"__q","__s"} dicts one level
+        # below the param name. The int8 "__q" tensor keeps the leading
+        # expert dim and shards; the "__s" scales are 1-D per-output-
+        # channel (expert-agnostic — see quantize_params) and replicate.
+        if ("moe" in keys and any(k in expert_keys for k in keys)
+                and keys[-1] != "__s"):
+            return NamedSharding(mesh, P(expert_axis))
+        return NamedSharding(mesh, P())
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    placed = [jax.device_put(leaf, spec_for(path, leaf)) for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
 def tp_param_specs(params: Any, model_axis: str = "model") -> Any:
     """PartitionSpec pytree matching :func:`shard_params_tp` (for pjit
     in_shardings in the train step)."""
