@@ -181,14 +181,20 @@ class InferenceEngine:
         if self.ep > 1:
             # Fail loudly on misconfig — silent full replication across an
             # expert mesh would burn ep-fold HBM/compute while the user
-            # believes experts are sharded.
-            expert_dims = [
-                leaf.shape[0]
-                for path, leaf in jax.tree_util.tree_flatten_with_path(
-                    params)[0]
-                if "moe" in [getattr(k, "key", None) for k in path]
-                and getattr(leaf, "ndim", 0) == 3
-            ]
+            # believes experts are sharded. Same key set as
+            # shard_params_ep (one source of truth: moe_param_specs).
+            from storm_tpu.parallel.moe import moe_param_specs
+
+            expert_keys = {
+                k for k, spec in moe_param_specs().items()
+                if "expert" in (spec or ())
+            }
+            expert_dims = []
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    params)[0]:
+                keys = [getattr(k, "key", None) for k in path]
+                if "moe" in keys and keys[-1] in expert_keys:
+                    expert_dims.append(leaf.shape[0])
             if not expert_dims:
                 raise ValueError(
                     f"model {model_cfg.name!r} has no MoE params; "

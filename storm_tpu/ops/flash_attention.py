@@ -2,10 +2,12 @@
 
 Why: the naive path materializes the (S, S) score matrix in HBM twice per
 layer; this kernel keeps the whole online-softmax accumulation in VMEM, so
-HBM traffic is just q/k/v in and o out. For ViT-B/16 (S=197) that is a
-modest win; for long sequences it is the difference between running and
-OOM — and it is the building block the ring-attention sequence-parallel
-path reuses per KV shard.
+HBM traffic is just q/k/v in and o out. Dispatch is shape-aware
+(ops/attention.py): below ~1024 tokens XLA's own fused attention is
+faster on-chip and serves (e.g. ViT-B/16's S=197); at and above it this
+kernel wins 2-3x (measured — BENCH_NOTES.md round 2). The ring-attention
+sequence-parallel path computes its per-shard partials with its own
+online-softmax math (parallel/ring_attention.py), not this kernel.
 
 Layout: inputs (B, H, S, D) are flattened to (B*H, S, D); the grid is
 (B*H, Sq_blocks); each program owns one (block_q, D) query tile and loops
@@ -85,11 +87,19 @@ def flash_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 512,
+    block_q: int = 512,
+    block_k: int = 2048,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """softmax(q k^T * scale) v for (B, H, S, D) inputs, fused on TPU."""
+    """softmax(q k^T * scale) v for (B, H, S, D) inputs, fused on TPU.
+
+    Block defaults are the measured-fastest on v5e (BENCH_NOTES.md round
+    2 block sweep: bq=512/bk=2048 runs S=2048 in 0.52 ms vs 0.91 ms with
+    the round-1 128/512 tiles — 3.25x XLA's fused attention); both clamp
+    to the padded sequence so direct short-shape callers (tests, sweeps,
+    future kernels built on this one) never pad q 8x just to fill a tile.
+    (The serving dispatch, ops/attention.py, only routes here at
+    S >= _flash_min_seq; ring attention uses its own per-shard math.)"""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if scale is None:
@@ -99,7 +109,9 @@ def flash_attention(
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
 
-    # Tile padding: D -> lane width; Sq -> block_q; Sk -> block_k.
+    # Tile padding: D -> lane width; Sq -> block_q; Sk -> block_k, with
+    # both block sizes clamped to the (pow2-padded) sequence lengths.
+    block_q = min(block_q, max(_LANE, 1 << (sq - 1).bit_length()))
     qf = _pad_to(_pad_to(qf, 2, _LANE), 1, block_q)
     bk = min(block_k, max(_LANE, 1 << (sk - 1).bit_length()))
     kf = _pad_to(_pad_to(kf, 2, _LANE), 1, bk)
