@@ -74,12 +74,20 @@ def w8a16_matmul(
     q: jnp.ndarray,
     s: jnp.ndarray,
     *,
-    block_m: int = 128,
-    block_n: int = _LANE,
-    block_k: int = 256,
+    block_m: int = 512,
+    block_n: int = 512,
+    block_k: int = 512,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """``x (..., K) @ (q (K, N) int8 * s (N,)) -> (..., N)`` in x.dtype."""
+    """``x (..., K) @ (q (K, N) int8 * s (N,)) -> (..., N)`` in x.dtype.
+
+    Block defaults from the round-2 on-chip sweep (BENCH_NOTES.md): the
+    round-1 128/128/256 tiles ran the vit_b16 mlp_in shape at 1.39 ms vs
+    0.60-0.67 ms with 512-wide tiles (~2.2x). Even tuned, XLA's own
+    dequant+matmul fusion remains faster at the zoo's compute-bound
+    shapes — ``weights="int8"`` is the recommended w8a16 mode; this
+    kernel's guarantee (int8 bytes are all that leaves HBM) matters in
+    weight-bandwidth-bound regimes (very large K x N, small M)."""
     *lead, k = x.shape
     kq, n = q.shape
     assert k == kq, f"contraction mismatch: x K={k}, q K={kq}"
