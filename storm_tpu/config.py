@@ -139,7 +139,11 @@ class OffsetsConfig:
     resumes, which the reference deliberately lacked (SURVEY.md §5.4).
     """
 
-    policy: str = "latest"  # 'latest' | 'earliest' | 'resume'
+    # 'txn': resolve positions from committed offsets like 'resume', but
+    # NEVER commit on ack — a transactional sink commits the consumed
+    # offsets inside its producer transaction (KIP-98 exactly-once); a
+    # spout-side commit would race ahead of uncommitted output.
+    policy: str = "latest"  # 'latest' | 'earliest' | 'resume' | 'txn'
     max_behind: Optional[int] = 0  # drop records more than N offsets behind; None = unbounded
     group_id: Optional[str] = None  # None = fresh random group per run (reference behavior)
     # True: partitions come from Kafka consumer-group coordination
@@ -155,8 +159,18 @@ class OffsetsConfig:
             raise ValueError(
                 "offsets.group_protocol requires an explicit group_id "
                 "(tasks must share one group to split partitions)")
-        if self.policy not in ("latest", "earliest", "resume"):
+        if self.policy not in ("latest", "earliest", "resume", "txn"):
             raise ValueError(f"unknown offsets policy {self.policy!r}")
+        if self.policy == "txn" and not self.group_id:
+            raise ValueError(
+                "offsets.policy='txn' requires an explicit group_id — the "
+                "transactional sink commits offsets to it, and a restart "
+                "must resume from the SAME group to be exactly-once")
+        if self.policy == "txn" and self.max_behind is not None:
+            raise ValueError(
+                "offsets.policy='txn' requires max_behind=None — dropping "
+                "stale records under a freshness clamp contradicts the "
+                "exactly-once contract (set it explicitly)")
 
 
 @dataclass
@@ -171,6 +185,13 @@ class SinkConfig:
     # into one transaction per micro-batch and ack only after commit.
     txn_batch: int = 64
     txn_ms: float = 100.0
+    # Consumer group to commit consumed offsets to INSIDE the producer
+    # transaction (AddOffsetsToTxn/TxnOffsetCommit) — closing the KIP-98
+    # consume-transform-produce loop. Must equal the spout's
+    # offsets.group_id, with offsets.policy='txn'. None = egress-only
+    # transactions (offsets commit separately; effectively-once across a
+    # crash between produce and offset commit).
+    offsets_group: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.mode not in ("async", "sync", "fire_and_forget",

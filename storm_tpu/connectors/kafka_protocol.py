@@ -734,6 +734,51 @@ class KafkaWireClient:
                     raise KafkaProtocolError(
                         f"add_partitions_to_txn error code {err}")
 
+    def add_offsets_to_txn(self, txn_id: str, pid: int, epoch: int,
+                           group: str) -> None:
+        """AddOffsetsToTxn (api 25 v0, KIP-98): register a consumer group's
+        offsets topic with the transaction, so a subsequent TxnOffsetCommit
+        commits atomically with the produced records. Routed to the
+        TRANSACTION coordinator."""
+        w = Writer()
+        w.string(txn_id).i64(pid).i16(epoch).string(group)
+        r = self._txn_request(txn_id, 25, 0, bytes(w.buf))
+        r.i32()  # throttle
+        err = r.i16()
+        if err:
+            raise KafkaProtocolError(f"add_offsets_to_txn error code {err}")
+
+    def txn_offset_commit(self, txn_id: str, group: str, pid: int,
+                          epoch: int,
+                          offsets: Dict[Tuple[str, int], int]) -> None:
+        """TxnOffsetCommit (api 28 v0, KIP-98): stage consumed offsets
+        inside the open transaction. They become the group's committed
+        offsets only when EndTxn commits (and vanish on abort) — the other
+        half of the consume-transform-produce exactly-once loop. Routed to
+        the GROUP coordinator (which owns the __consumer_offsets partition),
+        not the transaction coordinator."""
+        w = Writer()
+        w.string(txn_id).string(group).i64(pid).i16(epoch)
+        by_topic: Dict[str, List[Tuple[int, int]]] = {}
+        for (t, p), off in offsets.items():
+            by_topic.setdefault(t, []).append((p, off))
+        w.i32(len(by_topic))
+        for t, parts in by_topic.items():
+            w.string(t)
+            w.i32(len(parts))
+            for p, off in parts:
+                w.i32(p).i64(off).string(None)  # metadata
+        r = self._coordinator_request(group, 28, 0, bytes(w.buf))
+        r.i32()  # throttle
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                err = r.i16()
+                if err:
+                    raise KafkaProtocolError(
+                        f"txn_offset_commit error code {err}")
+
     def end_txn(self, txn_id: str, pid: int, epoch: int,
                 commit: bool) -> None:
         """EndTxn (api 26 v0): commit or abort the open transaction."""
@@ -1230,7 +1275,13 @@ class KafkaTxn:
     lazily (re)initializes the producer id for the transactional id;
     re-initialization bumps the epoch, fencing any zombie task still
     holding the old one. All control RPCs route via the transaction
-    coordinator (FindCoordinator type=1)."""
+    coordinator (FindCoordinator type=1).
+
+    ``send_offsets(group, offsets)`` stages consumed offsets INSIDE the
+    transaction (AddOffsetsToTxn + TxnOffsetCommit at commit time): the
+    group's committed position and the produced records become visible
+    atomically — the KIP-98 consume-transform-produce exactly-once loop
+    from the reference's own Kafka 0.11 era (pom.xml:55-78)."""
 
     def __init__(self, broker: "KafkaWireBroker", txn_id: str) -> None:
         self._broker = broker
@@ -1240,6 +1291,7 @@ class KafkaTxn:
         self._epoch = -1
         self._seqs: Dict[Tuple[str, int], int] = {}
         self._pending: Dict[Tuple[str, int], List[Tuple[Optional[bytes], bytes]]] = {}
+        self._offsets: Dict[str, Dict[Tuple[str, int], int]] = {}
         self._open = False
 
     def begin(self) -> None:
@@ -1248,7 +1300,19 @@ class KafkaTxn:
                 transactional_id=self.txn_id)
             self._seqs.clear()
         self._pending.clear()
+        self._offsets.clear()
         self._open = True
+
+    def send_offsets(self, group: str,
+                     offsets: Dict[Tuple[str, int], int]) -> None:
+        """Stage consumed offsets ``{(topic, partition): next_offset}`` to
+        commit atomically with this transaction's records. Merged max-wins
+        across calls within one transaction."""
+        assert self._open, "begin() first"
+        dst = self._offsets.setdefault(group, {})
+        for tp, off in offsets.items():
+            if off > dst.get(tp, -1):
+                dst[tp] = off
 
     def produce(self, topic: str, value, key=None, partition=None) -> None:
         assert self._open, "begin() first"
@@ -1272,6 +1336,7 @@ class KafkaTxn:
             return
         self._open = False
         pending, self._pending = self._pending, {}
+        offsets, self._offsets = self._offsets, {}
         try:
             if commit and pending:
                 self._client.add_partitions_to_txn(
@@ -1285,6 +1350,14 @@ class KafkaTxn:
                         transactional_id=self.txn_id)
                     self._seqs[(topic, partition)] = \
                         (seq + len(records)) & 0x7FFFFFFF
+            if commit:
+                for group, offs in offsets.items():
+                    if not offs:
+                        continue
+                    self._client.add_offsets_to_txn(
+                        self.txn_id, self._pid, self._epoch, group)
+                    self._client.txn_offset_commit(
+                        self.txn_id, group, self._pid, self._epoch, offs)
             self._client.end_txn(self.txn_id, self._pid, self._epoch, commit)
         except Exception:
             # Fenced / coordinator lost the txn — OR the socket died mid-way

@@ -168,25 +168,45 @@ class MemoryTxn:
         self._broker = broker
         self.txn_id = txn_id
         self._pending: List[tuple] = []
+        self._offsets: Dict[str, Dict[Tuple[str, int], int]] = {}
         self._open = False
 
     def begin(self) -> None:
         self._pending.clear()
+        self._offsets.clear()
         self._open = True
 
     def produce(self, topic: str, value, key=None, partition=None) -> None:
         assert self._open, "begin() first"
         self._pending.append((topic, value, key, partition))
 
+    def send_offsets(self, group: str,
+                     offsets: "Dict[Tuple[str, int], int]") -> None:
+        """Stage consumer-group offsets to commit atomically with the
+        records (same surface as ``KafkaTxn.send_offsets``)."""
+        assert self._open, "begin() first"
+        dst = self._offsets.setdefault(group, {})
+        for tp, off in offsets.items():
+            if off > dst.get(tp, -1):
+                dst[tp] = off
+
     def commit(self) -> None:
         assert self._open, "begin() first"
         self._open = False
         with self._broker._lock:
-            # all-or-nothing under the broker lock: no fetch interleaves
+            # all-or-nothing under the broker lock: no fetch interleaves,
+            # and staged offsets land with the records (never without them)
             for topic, value, key, partition in self._pending:
                 self._broker._produce_locked(topic, value, key, partition)
+            for group, offs in self._offsets.items():
+                for (topic, partition), off in offs.items():
+                    key = (group, topic, partition)
+                    if off > self._broker._committed.get(key, -1):
+                        self._broker._committed[key] = off
         self._pending.clear()
+        self._offsets.clear()
 
     def abort(self) -> None:
         self._open = False
         self._pending.clear()
+        self._offsets.clear()

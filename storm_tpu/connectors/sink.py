@@ -215,6 +215,24 @@ class TransactionalBrokerSink(BrokerSink):
     ``KafkaWireBroker.txn`` (real EndTxn wire protocol) and
     ``MemoryBroker.txn`` (atomic append at commit).
 
+    With ``SinkConfig.offsets_group`` set (and the spout on
+    ``offsets.policy='txn'`` with the same group), each tuple's source-log
+    provenance (``Tuple.origins``, stamped by the spout and unioned through
+    anchored emits) is folded into the transaction via
+    ``txn.send_offsets`` — consumed offsets and produced records commit
+    atomically, the full KIP-98 consume-transform-produce exactly-once
+    loop. A crash between produce and commit aborts both: the restarted
+    spout re-reads from the last committed offset and a read-committed
+    consumer sees each result exactly once.
+
+    Ordering: committing per-partition maxima is only safe because the
+    spout's ``txn`` policy delivers per-partition ORDERED (one outstanding
+    entry per partition, next fetched only after the previous tree acks —
+    Kafka Streams' processing model). An earlier offset can therefore
+    never still be in flight, or parked in the replay queue, while a later
+    one commits. Cross-partition parallelism and spout chunking
+    (``topology.spout_chunk``) carry the throughput.
+
     Beyond the reference: its KafkaBolt acks on per-record delivery
     confirmation at best (KafkaBolt.java:129-155); duplicates on replay
     are unavoidable there."""
@@ -229,6 +247,11 @@ class TransactionalBrokerSink(BrokerSink):
         txn_id = (f"{context.config.topology.name}-{context.component_id}"
                   f"-{context.task_index}")
         self._txn = self.broker.txn(txn_id)
+        self._offsets_group = self.sink_cfg.offsets_group
+        if self._offsets_group and not hasattr(self._txn, "send_offsets"):
+            raise TypeError(
+                "sink.offsets_group needs a transaction handle with "
+                "send_offsets (KafkaTxn / MemoryTxn)")
         self._blocking = bool(getattr(self.broker, "blocking", False))
         self._buf: list = []
         self._flush_lock = asyncio.Lock()
@@ -272,8 +295,20 @@ class TransactionalBrokerSink(BrokerSink):
 
             def run() -> None:
                 self._txn.begin()
-                for _, topic, key, value in batch:
+                # Fold each tuple's source provenance into {(topic,
+                # partition): next_offset} (max wins: origins carry
+                # last-consumed + 1) and commit it INSIDE the transaction —
+                # offsets never land without the records.
+                offs: dict = {}
+                for t, topic, key, value in batch:
                     self._txn.produce(topic, value, key)
+                    if self._offsets_group:
+                        for src_topic, src_part, next_off in t.origins:
+                            tp = (src_topic, src_part)
+                            if next_off > offs.get(tp, -1):
+                                offs[tp] = next_off
+                if offs:
+                    self._txn.send_offsets(self._offsets_group, offs)
                 self._txn.commit()
 
             try:

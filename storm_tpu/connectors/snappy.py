@@ -99,6 +99,8 @@ def decompress(data: bytes) -> bytes:
     """Decompress Kafka snappy payloads: xerial-framed when the magic
     header is present, raw block otherwise."""
     if data.startswith(_XERIAL_MAGIC):
+        if len(data) < len(_XERIAL_MAGIC) + 8:
+            raise SnappyError("truncated xerial header (missing version/compat)")
         pos = len(_XERIAL_MAGIC) + 8  # skip version + compat ints
         out = bytearray()
         while pos < len(data):

@@ -116,6 +116,16 @@ class BrokerSpout(Spout):
         self._bg: set = set()
         self._commit_hwm: Dict[int, int] = {}
         self._commit_lock = threading.Lock()
+        # policy='txn' (offsets committed by the transactional sink):
+        # per-partition ORDERED delivery — at most one outstanding entry
+        # (record or chunk) per partition, fetched only after the previous
+        # one's tuple tree completes. Without it, an earlier offset still
+        # in flight while a later one commits, followed by a crash, would
+        # resume past the unprocessed record (silent loss). This is Kafka
+        # Streams' per-partition processing model; cross-partition
+        # parallelism and chunking carry the throughput.
+        self._txn_mode = cfg.policy == "txn"
+        self._part_inflight: Dict[int, int] = {}
         for p in self.my_partitions:
             self.positions[p] = self._initial_position(p)
 
@@ -161,6 +171,9 @@ class BrokerSpout(Spout):
                 self.positions[p] = self._initial_position(p)
         for p in revoked:
             self.positions.pop(p, None)
+            # a revoked partition's in-flight bookkeeping must not block
+            # it forever if a later rebalance hands it back
+            self._part_inflight.pop(p, None)
 
     async def _group_poll(self) -> None:
         """Join on first use; heartbeat ~1/s; rejoin on rebalance."""
@@ -236,13 +249,19 @@ class BrokerSpout(Spout):
         for _ in range(len(self.my_partitions)):
             p = self.my_partitions[self._rr % len(self.my_partitions)]
             self._rr += 1
+            if self._txn_mode and self._part_inflight.get(p, 0):
+                continue  # ordered delivery: previous entry still open
             pos = self.positions[p]
+            # txn mode: one ENTRY per fetch (the chunk, or one record) so
+            # exactly one tuple tree per partition is ever outstanding.
+            size = (max(1, self.chunk) if self._txn_mode
+                    else self.fetch_size)
             if self._blocking:
                 records = await asyncio.to_thread(
-                    self.broker.fetch, self.topic, p, pos, self.fetch_size
+                    self.broker.fetch, self.topic, p, pos, size
                 )
             else:
-                records = self.broker.fetch(self.topic, p, pos, self.fetch_size)
+                records = self.broker.fetch(self.topic, p, pos, size)
             if not records:
                 continue
             # Emit FIRST, advance the cursor after: an exception mid-loop
@@ -255,9 +274,15 @@ class BrokerSpout(Spout):
                 # multiply network fetches for blocking brokers.
                 records = list(records)
                 for i in range(0, len(records), self.chunk):
+                    if self._txn_mode:
+                        self._part_inflight[p] = \
+                            self._part_inflight.get(p, 0) + 1
                     await self._emit_chunk(records[i : i + self.chunk])
             else:
                 for rec in records:
+                    if self._txn_mode:
+                        self._part_inflight[p] = \
+                            self._part_inflight.get(p, 0) + 1
                     await self._emit(rec)
             self.positions[p] = records[-1].offset + 1
             return True
@@ -273,8 +298,13 @@ class BrokerSpout(Spout):
         MemoryBroker and the Kafka wire client); the latency histograms run
         on ``perf_counter``, so rebase append time onto the perf basis.
         Clamped to ``now`` so a producer with a skewed-forward clock can't
-        produce negative latency."""
+        produce negative latency, and to age 0 when the record carries no
+        real timestamp (Kafka baseTimestamp=-1 sentinel decodes to ts<=0,
+        which would otherwise read as an epoch-scale age and poison the
+        e2e histograms)."""
         now_perf = time.perf_counter()
+        if rec.timestamp <= 0:
+            return now_perf
         age = time.time() - rec.timestamp
         return now_perf - max(age, 0.0)
 
@@ -287,6 +317,8 @@ class BrokerSpout(Spout):
             msg_id=msg_id,
             # Oldest record in the chunk: its queueing is the one that counts.
             root_ts=self._append_root_ts(first),
+            origins=frozenset(
+                {(self.topic, first.partition, last.offset + 1)}),
         )
 
     async def _emit(self, rec: Record) -> None:
@@ -296,6 +328,7 @@ class BrokerSpout(Spout):
             Values([rec.value.decode("utf-8", "replace")]),
             msg_id=msg_id,
             root_ts=self._append_root_ts(rec),
+            origins=frozenset({(self.topic, rec.partition, rec.offset + 1)}),
         )
 
     @staticmethod
@@ -307,6 +340,16 @@ class BrokerSpout(Spout):
 
     def ack(self, msg_id: Any) -> None:
         self.pending.pop(msg_id, None)
+        if self._txn_mode:
+            # Entry complete (its offsets committed in the sink's txn):
+            # the partition may fetch its next entry. fail() deliberately
+            # does NOT decrement — a failed entry stays outstanding through
+            # the replay queue until its re-emission acks, keeping the
+            # partition's delivery strictly ordered.
+            p, _ = self._msg_part_off(msg_id)
+            n = self._part_inflight.get(p, 0)
+            if n > 0:
+                self._part_inflight[p] = n - 1
         if self.offsets_cfg.policy == "resume":
             p, off = self._msg_part_off(msg_id)
             if self._membership is not None and p not in self.my_partitions:

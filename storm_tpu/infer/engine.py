@@ -14,6 +14,7 @@ Outputs are softmax probabilities, matching the reference's fetch of
 
 from __future__ import annotations
 
+import gc
 import logging
 import sys
 import threading
@@ -463,18 +464,28 @@ def shared_engine(
         # Another thread owns the build: wait for its result instead of
         # allocating a duplicate param copy — N bolt tasks swapping the
         # same model concurrently must cost ONE build (param HBM +
-        # compile), not N.
+        # compile), not N. The owner's finally below guarantees this
+        # future resolves (value or exception) — no unbounded hang.
         return fut.result()
     # We own the build. Build OUTSIDE the lock: compile can take tens of
     # seconds and the UI thread polls engine_inventory under this lock.
+    # The try starts IMMEDIATELY after registration so an async exception
+    # (KeyboardInterrupt) landing anywhere before completion still pops
+    # the _BUILDS entry and resolves the future — a stale entry would
+    # serve a phantom engine forever; an unresolved future would hang
+    # waiters (no timeout) permanently.
+    engine = None
     try:
         engine = InferenceEngine(model_cfg, sharding_cfg, batch_cfg)
-    except BaseException as e:
-        with _ENGINES_LOCK:
-            _BUILDS.pop(key, None)
-        fut.set_exception(e)
-        raise
-    try:
+        if _insert_would_exceed_budget(engine):
+            # Collect BEFORE taking the lock: an engine held only by a
+            # reference cycle (e.g. a completed swap's rollback closure)
+            # looks externally-referenced to the refcount probe until the
+            # cycle collector runs. gc.collect() under _ENGINES_LOCK would
+            # stall every cache reader for a full-heap pass AND can
+            # deadlock — finalizers may re-enter the cache (unload_engine,
+            # inventory), and the lock is not reentrant.
+            gc.collect()
         with _ENGINES_LOCK:
             _ENGINES[key] = engine
             try:
@@ -486,13 +497,17 @@ def shared_engine(
                 # eviction or the inventory log hiccuped.
                 logger.exception("engine cache bookkeeping failed")
     finally:
-        # ALWAYS clear the in-progress entry and resolve — even on
-        # BaseException (KeyboardInterrupt while acquiring the lock).
-        # A stale _BUILDS future would serve the engine forever while
-        # keeping it invisible to the cache/eviction/inventory; an
-        # unresolved future would hang waiters (no timeout) permanently.
-        _BUILDS.pop(key, None)
-        fut.set_result(engine)
+        with _ENGINES_LOCK:
+            _BUILDS.pop(key, None)
+        if engine is not None:
+            fut.set_result(engine)
+        else:
+            exc = sys.exc_info()[1]
+            fut.set_exception(
+                exc
+                if exc is not None
+                else RuntimeError("engine build aborted before completion")
+            )
     return engine
 
 
@@ -511,7 +526,17 @@ def unload_engine(engine: InferenceEngine) -> bool:
 def set_engine_cache_limit(max_param_bytes: Optional[int]) -> None:
     """Cap total cached engine param bytes; least-recently-used engines are
     dropped from the cache on the next ``shared_engine`` insert. ``None``
-    restores the default (85% of device HBM when the backend reports it)."""
+    restores the default (85% of device HBM when the backend reports it).
+
+    **Best-effort semantics**: only *orphaned* engines (no references
+    outside the cache) are evicted — dropping one a bolt still serves from
+    would free nothing and force a duplicate build. Orphan detection is
+    refcount-based (CPython only; elsewhere nothing is ever evicted), so an
+    engine pinned by a reference *cycle* stays resident until the cycle
+    collector runs — eviction triggers ``gc.collect()`` first when over
+    budget to break such cycles. Degradation is always in the safe
+    direction (keep, never double-free), but the cap is a target, not a
+    hard bound."""
     global _ENGINE_CACHE_LIMIT
     with _ENGINES_LOCK:
         _ENGINE_CACHE_LIMIT = max_param_bytes
@@ -547,11 +572,27 @@ def _externally_referenced(k: tuple) -> bool:
         return True
 
 
-def _evict_to_budget_locked(keep: tuple) -> None:
+def _cache_limit() -> Optional[int]:
     limit = _ENGINE_CACHE_LIMIT
     if limit is None:
         hbm = _device_hbm_limit()
         limit = int(0.85 * hbm) if hbm else None
+    return limit
+
+
+def _insert_would_exceed_budget(engine: "InferenceEngine") -> bool:
+    """Brief-lock budget probe used to decide whether to gc.collect()
+    before inserting ``engine`` (the collect itself must run unlocked)."""
+    limit = _cache_limit()
+    if limit is None:
+        return False
+    with _ENGINES_LOCK:
+        total = sum(e.param_bytes_per_device() for e in _ENGINES.values())
+    return total + engine.param_bytes_per_device() > limit
+
+
+def _evict_to_budget_locked(keep: tuple) -> None:
+    limit = _cache_limit()
     if limit is None:
         return
     # Per-DEVICE bytes: the budget is one chip's HBM, and TP-sharded

@@ -71,6 +71,7 @@ class OutputCollector:
         anchors: Optional[Iterable[Tuple]] = None,
         msg_id: Any = None,
         root_ts: Optional[float] = None,
+        origins: Optional[frozenset] = None,
         direct_task: Optional[int] = None,
     ) -> int:
         """Emit a tuple downstream. Returns the number of deliveries.
@@ -93,8 +94,25 @@ class OutputCollector:
             roots = frozenset().union(*(a.anchors for a in anchor_list))
             if anchor_list and root_ts is None:
                 ts = min(a.root_ts for a in anchor_list)
+            if origins is None and any(a.origins for a in anchor_list):
+                # Provenance follows anchoring: a derived tuple carries the
+                # source-log positions of everything it was computed from.
+                # Folded to the per-(topic, partition) MAX here, not a raw
+                # union — an aggregating bolt anchored to N inputs must
+                # carry O(partitions) triples, not O(N) (only the maximum
+                # is ever consumed, by the transactional sink's offsets
+                # commit).
+                acc: dict = {}
+                for a in anchor_list:
+                    for (src_t, src_p, off) in a.origins:
+                        k = (src_t, src_p)
+                        if off > acc.get(k, -1):
+                            acc[k] = off
+                origins = frozenset(
+                    (src_t, src_p, off) for (src_t, src_p), off in acc.items())
         else:
             roots = frozenset()
+        origin_set = origins if origins is not None else frozenset()
 
         probe = Tuple(
             values=list(values),
@@ -156,6 +174,7 @@ class OutputCollector:
                 edge_id=edge,
                 anchors=roots,
                 root_ts=ts,
+                origins=origin_set,
             )
             await inbox.put(t)
             n += 1

@@ -38,6 +38,18 @@ from storm_tpu.runtime.tuples import Tuple, Values, new_id
 log = logging.getLogger("storm_tpu.shell")
 
 
+def _close_subprocess_transport(proc) -> None:
+    """Best-effort close of an asyncio subprocess transport so its
+    ``__del__`` never runs against a closed loop. Reaches into ``_transport``
+    because :class:`asyncio.subprocess.Process` exposes no public close."""
+    transport = getattr(proc, "_transport", None)
+    if transport is not None:
+        try:
+            transport.close()
+        except RuntimeError:
+            pass  # loop already closed: nothing better is possible here
+
+
 class _ShellProtocol:
     """Shared multilang framing: spawn + handshake, newline-JSON send, and
     end-terminated reads — one copy for bolt and spout hosts."""
@@ -86,16 +98,32 @@ class _ShellProtocol:
                 f"shell component {self.command} failed the handshake: {hello}")
 
     def _terminate(self) -> None:
-        """Kill + asynchronously reap (an unawaited child leaves the
-        transport open and a ResourceWarning)."""
-        if self._proc is not None and self._proc.returncode is None:
-            self._proc.kill()
+        """Kill + asynchronously reap + close the transport.
+
+        An unawaited child leaves the transport open (ResourceWarning);
+        a transport still open when its loop closes raises "Event loop is
+        closed" from ``BaseSubprocessTransport.__del__`` at gc time — so
+        the transport is ALWAYS closed: immediately when the child has
+        already exited, or from the reaper's done-callback (which still
+        runs during loop shutdown's cancellation sweep) otherwise."""
+        proc, self._proc = self._proc, None
+        if proc is None:
+            return
+        if proc.returncode is None:
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
             try:
                 loop = asyncio.get_event_loop()
-                self._reaper = loop.create_task(self._proc.wait())
+                task = loop.create_task(proc.wait())
+                task.add_done_callback(
+                    lambda _t, p=proc: _close_subprocess_transport(p))
+                self._reaper = task
+                return
             except RuntimeError:
-                pass  # no loop: interpreter shutdown
-        self._proc = None
+                pass  # no loop: interpreter shutdown; close directly
+        _close_subprocess_transport(proc)
 
 
 class ShellBolt(_ShellProtocol, Bolt):

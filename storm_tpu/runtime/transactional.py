@@ -23,11 +23,15 @@ built on the framework's existing at-least-once ledger + stateful bolts:
   sources that cannot guarantee identical replay content.
 - :class:`TransactionalBolt` — processes one batch per tuple via
   ``process_batch(txid, records, state)``.
-- :class:`TransactionalSink` — idempotent egress: remembers the last txid
-  produced and skips replayed batches. (The crash window between produce
-  and state checkpoint is the one Kafka closes with broker-side
-  transactions; here the guarantee is effectively-once and documented,
-  not silently over-claimed.)
+- :class:`TransactionalSink` — exactly-once egress. Over a broker with
+  transactions (``.txn()``: MemoryBroker, KafkaWireBroker), each batch's
+  records AND a ``last_txid`` marker (stored as a consumer-group offset
+  via KIP-98 TxnOffsetCommit) commit in ONE broker transaction — a crash
+  between produce and checkpoint replays the batch, the marker identifies
+  it as already produced, and read-committed consumers never see the
+  aborted half. Over a broker without transactions the sink degrades to
+  txid-idempotent produce, where the produce-vs-checkpoint crash window
+  is effectively-once (documented, not over-claimed).
 
 One batch is in flight at a time (Trident pipelines processing but
 serializes commits; with a single in-flight batch the two coincide), so
@@ -319,24 +323,74 @@ class TransactionalBolt(StatefulBolt):
 
 
 class TransactionalSink(StatefulBolt):
-    """Idempotent egress: produce each batch's output once, keyed by txid.
+    """Exactly-once egress: produce each batch's output once, keyed by txid.
 
     Expects tuples with fields ``(message, txid)`` (or ``(batch, txid)``
     with a list payload). Skips txids at or below the last produced one —
-    the replayed half of a failed tuple tree does not duplicate output."""
+    the replayed half of a failed tuple tree does not duplicate output.
 
-    def __init__(self, broker, topic: str) -> None:
+    When the broker supports transactions (``.txn()``), the batch's
+    records and the txid marker commit ATOMICALLY: the marker is written
+    as a consumer-group offset inside the producer transaction
+    (``send_offsets`` -> KIP-98 AddOffsetsToTxn/TxnOffsetCommit on the
+    wire broker), so a crash between produce and state checkpoint cannot
+    double-produce — on replay the durable marker (read back at first
+    execute) says the txid already committed. ``use_txn=False`` forces
+    the plain idempotent path (effectively-once across that crash
+    window)."""
+
+    # Defaults for instances driven without prepare() (unit harnesses):
+    # plain idempotent produce, no broker transaction.
+    _txn = None
+    _marker_synced = True
+    _blocking = False
+
+    def __init__(self, broker, topic: str,
+                 use_txn: "bool | None" = None) -> None:
         self.broker = broker
         self.topic = topic
+        # None = auto: transactional whenever the broker can
+        self.use_txn = use_txn
 
     def clone(self) -> "TransactionalSink":
-        return TransactionalSink(self.broker, self.topic)
+        return TransactionalSink(self.broker, self.topic, self.use_txn)
 
     def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
         super().prepare(context, collector)
         _require_single_task(context)
+        use = self.use_txn
+        if use is None:
+            use = hasattr(self.broker, "txn")
+        self._txn = None
+        self._marker_synced = not use
+        if use:
+            ident = (f"{context.config.topology.name}-"
+                     f"{context.component_id}-{context.task_index}")
+            self._txn = self.broker.txn(ident)
+            # txid marker namespace: a consumer group whose 'offset' for
+            # (topic, 0) is the last committed txid — durable at the
+            # broker, atomic with the records.
+            self._marker_group = f"txnsink.{ident}"
+        self._blocking = bool(getattr(self.broker, "blocking", False))
+
+    async def _call(self, fn, *args):
+        if self._blocking:
+            return await asyncio.to_thread(fn, *args)
+        return fn(*args)
+
+    async def _sync_marker(self) -> None:
+        """Adopt the broker-side txid marker when it is ahead of local
+        state — the exact crash shape the atomic commit exists for
+        (produced + marker committed, state checkpoint lost)."""
+        marker = await self._call(
+            self.broker.committed, self._marker_group, self.topic, 0)
+        if marker is not None and marker > self.state.get("last_txid", -1):
+            self.state.put("last_txid", marker)
+        self._marker_synced = True
 
     async def execute(self, t: Tuple) -> None:
+        if not self._marker_synced:
+            await self._sync_marker()
         txid = t.get("txid", None)
         last = self.state.get("last_txid", -1)
         if txid is not None and txid <= last:
@@ -346,13 +400,29 @@ class TransactionalSink(StatefulBolt):
         messages = payload if payload is not None else [t.get("message")]
         values = [m if isinstance(m, (str, bytes)) else json.dumps(m)
                   for m in messages]
-        produce = self.broker.produce
-        if getattr(self.broker, "blocking", False):
-            for value in values:
-                await asyncio.to_thread(produce, self.topic, value)
+        if self._txn is not None:
+            def commit_batch() -> None:
+                self._txn.begin()
+                for value in values:
+                    self._txn.produce(self.topic, value)
+                if txid is not None:
+                    self._txn.send_offsets(
+                        self._marker_group, {(self.topic, 0): txid})
+                self._txn.commit()
+
+            try:
+                await self._call(commit_batch)
+            except Exception as e:
+                try:
+                    await self._call(self._txn.abort)
+                except Exception:
+                    pass  # fenced on next begin()
+                self.collector.report_error(e)
+                self.collector.fail(t)
+                return
         else:
             for value in values:
-                produce(self.topic, value)
+                await self._call(self.broker.produce, self.topic, value)
         if txid is not None:
             self.state.put("last_txid", txid)
         self.checkpoint_now()

@@ -75,6 +75,13 @@ class Tuple:
     # perf_counter timestamp when the root entered the topology; flows with
     # the tuple for end-to-end latency metrics.
     root_ts: float = 0.0
+    # Source-log provenance: ``(topic, partition, next_offset)`` triples
+    # identifying the ingest records this tuple derives from (next_offset =
+    # the offset to COMMIT, i.e. last consumed + 1). Spouts stamp it;
+    # anchored emits union it downstream — so a transactional sink can
+    # commit the consumed offsets inside its producer transaction (KIP-98
+    # consume-transform-produce exactly-once).
+    origins: FrozenSet[tuple] = frozenset()
 
     def __getitem__(self, i: int) -> Any:
         return self.values[i]

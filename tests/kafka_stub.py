@@ -158,8 +158,12 @@ class KafkaStubBroker:
             return self._init_producer_id(r)
         if api == 24:
             return self._add_partitions_to_txn(r)
+        if api == 25:
+            return self._add_offsets_to_txn(r)
         if api == 26:
             return self._end_txn(r)
+        if api == 28:
+            return self._txn_offset_commit(r)
         raise RuntimeError(f"stub does not implement api {api}")
 
     def _metadata(self, r: Reader) -> bytes:
@@ -193,7 +197,8 @@ class KafkaStubBroker:
                 st = self._txns.get(txn_id)
                 if st is None:
                     st = {"pid": self._next_pid, "epoch": 0,
-                          "pending": [], "parts": set()}
+                          "pending": [], "parts": set(),
+                          "pending_offsets": {}, "offset_groups": set()}
                     self._next_pid += 1
                     self._txns[txn_id] = st
                 else:
@@ -201,6 +206,8 @@ class KafkaStubBroker:
                     st["epoch"] += 1
                     st["pending"] = []
                     st["parts"] = set()
+                    st["pending_offsets"] = {}
+                    st["offset_groups"] = set()
                 pid, epoch = st["pid"], st["epoch"]
         w = Writer()
         w.i32(0).i16(0).i64(pid).i16(epoch)  # throttle, err, pid, epoch
@@ -242,6 +249,54 @@ class KafkaStubBroker:
                 w.i32(p).i16(err)
         return bytes(w.buf)
 
+    def _add_offsets_to_txn(self, r: Reader) -> bytes:
+        """AddOffsetsToTxn v0: register a group with the transaction; the
+        group's TxnOffsetCommit offsets then land atomically at EndTxn."""
+        txn_id = r.string()
+        pid = r.i64()
+        epoch = r.i16()
+        group = r.string()
+        with self._lock:
+            st, err = self._txn_check(txn_id, pid, epoch)
+            if not err:
+                st["offset_groups"].add(group)
+        w = Writer()
+        w.i32(0).i16(err)  # throttle, error
+        return bytes(w.buf)
+
+    def _txn_offset_commit(self, r: Reader) -> bytes:
+        """TxnOffsetCommit v0: stage offsets inside the open transaction —
+        visible in OffsetFetch only after EndTxn(commit)."""
+        txn_id = r.string()
+        group = r.string()
+        pid = r.i64()
+        epoch = r.i16()
+        staged: List[Tuple[str, int, int]] = []
+        w = Writer()
+        w.i32(0)  # throttle
+        n_topics = r.i32()
+        w.i32(n_topics)
+        with self._lock:
+            st, err = self._txn_check(txn_id, pid, epoch)
+            if not err and group not in st["offset_groups"]:
+                err = 48  # group not registered via AddOffsetsToTxn
+            for _ in range(n_topics):
+                topic = r.string()
+                w.string(topic)
+                n_parts = r.i32()
+                w.i32(n_parts)
+                for _ in range(n_parts):
+                    part = r.i32()
+                    off = r.i64()
+                    r.string()  # metadata
+                    if not err:
+                        staged.append((topic, part, off))
+                    w.i32(part).i16(err)
+            if not err:
+                for topic, part, off in staged:
+                    st["pending_offsets"][(group, topic, part)] = off
+        return bytes(w.buf)
+
     def _end_txn(self, r: Reader) -> bytes:
         txn_id = r.string()
         pid = r.i64()
@@ -255,8 +310,15 @@ class KafkaStubBroker:
                         self._ensure(topic)
                         self._logs[(topic, part)].append(
                             (key, value, time.time()))
+                    # offsets land atomically with the records (KIP-98:
+                    # the commit marker covers __consumer_offsets too)
+                    for (group, topic, part), off in \
+                            st["pending_offsets"].items():
+                        self._commits[(group, topic, part)] = off
                 st["pending"] = []
                 st["parts"] = set()
+                st["pending_offsets"] = {}
+                st["offset_groups"] = set()
         w = Writer()
         w.i32(0).i16(err)
         return bytes(w.buf)

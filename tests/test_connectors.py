@@ -652,3 +652,13 @@ def test_append_root_ts_clamps_future_timestamps():
     ts_future = spout._append_root_ts(future)
     assert 1.3 <= now - ts_past <= 1.8  # ~1.5s of age preserved
     assert ts_future <= _time.perf_counter()  # clamped, never negative age
+
+    # Kafka baseTimestamp=-1 sentinel (no producer timestamp) decodes to
+    # ts<=0; the clock must fall back to age 0, not an epoch-scale age
+    # that poisons the e2e histograms.
+    sentinel = Record("t", 0, 2, None, b"v", -0.001)
+    zero = Record("t", 0, 3, None, b"v", 0.0)
+    for rec in (sentinel, zero):
+        before = _time.perf_counter()
+        ts = spout._append_root_ts(rec)
+        assert before <= ts <= _time.perf_counter()  # age ~0

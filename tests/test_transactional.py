@@ -325,3 +325,62 @@ def test_tx_state_checkpointed_before_ack(run, tmp_path):
             await cluster.shutdown()
 
     run(go(), timeout=60)
+
+
+def test_tx_sink_marker_survives_state_loss(run):
+    """Broker-transaction-backed TransactionalSink: the txid marker commits
+    atomically with the records (as a consumer-group offset inside the
+    producer transaction), so losing the sink's LOCAL state — the old
+    effectively-once crash window: records produced, crash before the
+    checkpoint — no longer double-produces. A 'restarted' sink with empty
+    state reads the durable marker back and skips the replayed txid."""
+    from storm_tpu.config import Config
+    from storm_tpu.runtime.base import TopologyContext
+    from storm_tpu.runtime.tuples import Tuple
+
+    class _Coll:
+        def __init__(self):
+            self.acked, self.failed = [], []
+
+        def ack(self, t):
+            self.acked.append(t)
+
+        def fail(self, t):
+            self.failed.append(t)
+
+        def report_error(self, e):
+            pass
+
+    def make_sink(broker):
+        sink = TransactionalSink(broker, "out")
+        ctx = TopologyContext("sink", 0, 1, Config())
+        sink.prepare(ctx, None)
+        sink.collector = _Coll()
+        sink.init_state(KeyValueState())
+        return sink
+
+    async def go():
+        broker = MemoryBroker()
+        t = Tuple(values=[["m1", "m2"], 7], fields=("batch", "txid"),
+                  source_component="c", source_task=0)
+
+        sink = make_sink(broker)
+        assert sink._txn is not None  # MemoryBroker.txn engaged
+        await sink.execute(t)
+        assert broker.topic_size("out") == 2
+        # marker committed atomically with the records
+        assert broker.committed(sink._marker_group, "out", 0) == 7
+
+        # crash: state checkpoint never happened -> fresh sink, empty state
+        sink2 = make_sink(broker)
+        await sink2.execute(t)  # replayed batch
+        assert broker.topic_size("out") == 2  # NOT 4: marker recognized
+        assert len(sink2.collector.acked) == 1
+
+        # a genuinely new txid still produces
+        t2 = Tuple(values=[["m3"], 8], fields=("batch", "txid"),
+                   source_component="c", source_task=0)
+        await sink2.execute(t2)
+        assert broker.topic_size("out") == 3
+
+    run(go(), timeout=10)
