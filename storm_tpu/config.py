@@ -171,6 +171,18 @@ class OffsetsConfig:
                 "offsets.policy='txn' requires max_behind=None — dropping "
                 "stale records under a freshness clamp contradicts the "
                 "exactly-once contract (set it explicitly)")
+        if self.policy == "txn" and self.group_protocol:
+            # TxnOffsetCommit v0 carries no group generation (KIP-447
+            # fencing is post-reference-era): a task whose partition was
+            # rebalanced away could still commit a STALE offset for it
+            # inside a transaction, regressing the group position and
+            # duplicating records — exactly what 'txn' promises not to do.
+            # Static task-index assignment has no handoffs, so no window.
+            raise ValueError(
+                "offsets.policy='txn' requires group_protocol=False: "
+                "v0-era TxnOffsetCommit has no rebalance fencing, so a "
+                "revoked partition's in-flight offsets could regress the "
+                "group position (use static partition assignment)")
 
 
 @dataclass
@@ -241,6 +253,9 @@ class BrokerConfig:
     # sends reuse their sequence, so the broker appends at most once —
     # the sink's retry path stops duplicating records.
     idempotent: bool = False
+    # Egress codec for kind='kafka' (None = uncompressed); gzip/snappy/lz4,
+    # message_format='v2' only. Ingest decodes all three regardless.
+    compression: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.kind not in ("memory", "kafka"):
@@ -251,6 +266,14 @@ class BrokerConfig:
         if self.message_format not in ("v1", "v2"):
             raise ValueError(
                 f"broker.message_format must be v1|v2, got {self.message_format!r}")
+        if self.compression is not None:
+            if self.compression not in ("gzip", "snappy", "lz4"):
+                raise ValueError(
+                    f"broker.compression must be gzip|snappy|lz4, "
+                    f"got {self.compression!r}")
+            if self.message_format != "v2":
+                raise ValueError(
+                    "broker.compression requires broker.message_format='v2'")
 
 
 def _apply_section(target, values: dict) -> None:

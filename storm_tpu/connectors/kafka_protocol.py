@@ -16,11 +16,16 @@ the same era as the reference's Kafka 0.11 (pom.xml:55-78):
   commits (generation -1, empty member), no group-membership protocol
 - InitProducerId v0 (api 22), AddPartitionsToTxn v0 (api 24), EndTxn v0
   (api 26) — KIP-98 idempotent + transactional produce
+- AddOffsetsToTxn v0 (api 25), TxnOffsetCommit v0 (api 28) — offsets
+  inside the transaction (consume-transform-produce exactly-once)
+- ApiVersions v0 (api 18) — connect-time probe that fails LOUDLY with a
+  compatibility matrix on brokers that dropped these pinned versions
+  (post-KIP-896 removals), making the era-pinning an explicit contract
 
-Produced messages are uncompressed (attributes=0); fetched gzip wrapper
-messages from other producers are decompressed (relative inner offsets per
-KIP-31); snappy/lz4 are rejected with a clear error rather than silently
-dropped.
+Codecs: gzip, snappy (xerial + raw), and lz4 (Kafka framing, legacy
+broken-HC header tolerated) are decoded on fetch — the full 0.11-era
+producer codec surface; zstd (post-2.1) is rejected with a clear error.
+Produce ships uncompressed, gzip, snappy, or lz4 (v2 batches).
 
 :class:`KafkaWireBroker` adapts this client to the same surface as
 :class:`storm_tpu.connectors.memory.MemoryBroker`, so ``BrokerSpout`` /
@@ -32,6 +37,7 @@ real sockets (tests/kafka_stub.py).
 
 from __future__ import annotations
 
+import logging
 import socket
 import struct
 import threading
@@ -42,6 +48,8 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from storm_tpu.connectors.memory import Record
+
+logger = logging.getLogger("storm_tpu.kafka")
 
 
 class KafkaProtocolError(RuntimeError):
@@ -181,10 +189,16 @@ def decode_message_set(topic: str, partition: int, data: bytes) -> List[Record]:
             from storm_tpu.connectors.snappy import decompress as _snappy
 
             decompressed = _snappy(value)
+        elif codec == 3:
+            from storm_tpu.connectors.lz4 import decompress_frame as _lz4
+
+            # v0/v1-era Kafka lz4 (including the legacy broken-HC frame
+            # header variant — checksums unvalidated by design)
+            decompressed = _lz4(value)
         else:
             raise KafkaProtocolError(
                 f"unsupported compression codec {codec} "
-                "(gzip=1 and snappy=2 supported; lz4/zstd are not)"
+                "(gzip=1, snappy=2, lz4=3 supported; zstd is not)"
             )
         # compressed wrapper: the value is an inner message set. For magic 1
         # (KIP-31) inner offsets are 0-based relative and the wrapper carries
@@ -256,9 +270,9 @@ def encode_record_batch(
     idempotence fields (default: -1/-1/-1, non-idempotent)."""
     from storm_tpu.native import crc32c
 
-    if compression not in (None, "gzip", "snappy"):
+    if compression not in (None, "gzip", "snappy", "lz4"):
         raise KafkaProtocolError(
-            f"unsupported compression {compression!r} (gzip/snappy)")
+            f"unsupported compression {compression!r} (gzip/snappy/lz4)")
     body = bytearray()
     for i, (key, value) in enumerate(records):
         rec = bytearray()
@@ -292,6 +306,12 @@ def encode_record_batch(
         # era too, not just v0/v1 wrapper messages.
         payload = _snappy.compress(payload, xerial=True)
         attrs |= 2  # codec bits: snappy
+    elif compression == "lz4":
+        from storm_tpu.connectors import lz4 as _lz4
+
+        # spec-correct frame (KIP-57 fixed header checksum for v2 batches)
+        payload = _lz4.compress_frame(payload)
+        attrs |= 3  # codec bits: lz4
     after_crc = Writer()
     after_crc.i16(attrs)
     after_crc.i32(len(records) - 1)  # lastOffsetDelta
@@ -359,10 +379,14 @@ def decode_record_batch(topic: str, partition: int, data: bytes,
         # sniffs the header and accepts raw blocks as well (non-Java
         # producers sometimes ship them).
         payload = _snappy(payload)
+    elif codec == 3:
+        from storm_tpu.connectors.lz4 import decompress_frame as _lz4
+
+        payload = _lz4(payload)
     elif codec != 0:
         raise KafkaProtocolError(
             f"unsupported record-batch codec {codec} "
-            "(none/gzip/snappy supported; lz4/zstd are not)")
+            "(none/gzip/snappy/lz4 supported; zstd is not)")
     records: List[Record] = []
     pos = 0
     for _ in range(count):
@@ -451,6 +475,55 @@ class _PartitionMeta:
     leader: int
 
 
+#: Every (api, version) this client can put on the wire, grouped by the
+#: FEATURE that needs it — the compat probe hard-fails only on features a
+#: handle actually uses ('core' always; the rest are registered by
+#: KafkaWireBroker/KafkaTxn/GroupMembership), so a genuine 0.10 broker
+#: with no transaction support still serves the core path while a
+#: post-KIP-896 broker is refused loudly. docs/OPERATIONS.md carries the
+#: resulting broker-compatibility table.
+API_FEATURES: "Dict[str, Dict[int, Tuple[str, Tuple[int, ...]]]]" = {
+    "core": {
+        0: ("Produce", (2,)),
+        1: ("Fetch", (2,)),
+        2: ("ListOffsets", (0,)),
+        3: ("Metadata", (0,)),
+        8: ("OffsetCommit", (2,)),
+        9: ("OffsetFetch", (1,)),
+        10: ("FindCoordinator", (0,)),
+    },
+    # message_format='v2' (KIP-98 record batches; idempotence rides it)
+    "batches-v2": {
+        0: ("Produce", (3,)),
+        22: ("InitProducerId", (0,)),
+    },
+    # KIP-98 transactions (incl. offsets-in-transaction)
+    "txn": {
+        10: ("FindCoordinator", (1,)),
+        22: ("InitProducerId", (0,)),
+        24: ("AddPartitionsToTxn", (0,)),
+        25: ("AddOffsetsToTxn", (0,)),
+        26: ("EndTxn", (0,)),
+        28: ("TxnOffsetCommit", (0,)),
+    },
+    # consumer-group coordination (offsets.group_protocol)
+    "group": {
+        11: ("JoinGroup", (0,)),
+        12: ("Heartbeat", (0,)),
+        13: ("LeaveGroup", (0,)),
+        14: ("SyncGroup", (0,)),
+    },
+}
+
+#: Flat view (api -> (name, every pinned version)) — what a fully-featured
+#: era broker serves; the test stub advertises this by default.
+PINNED_API_VERSIONS: "Dict[int, Tuple[str, Tuple[int, ...]]]" = {}
+for _apis in API_FEATURES.values():
+    for _k, (_n, _vs) in _apis.items():
+        _, _have = PINNED_API_VERSIONS.get(_k, (_n, ()))
+        PINNED_API_VERSIONS[_k] = (_n, tuple(sorted(set(_have) | set(_vs))))
+
+
 class KafkaWireClient:
     def __init__(
         self,
@@ -468,6 +541,11 @@ class KafkaWireClient:
         self._meta: Dict[str, Dict[int, _PartitionMeta]] = {}
         self._coordinators: Dict[str, Tuple[str, int]] = {}
         self._lock = threading.Lock()
+        self._compat_checked = False
+        #: feature groups this client must have (see API_FEATURES);
+        #: broker handles register more via ensure_features.
+        self.features: set = {"core"}
+        self._advertised: Optional[Dict[int, Tuple[int, int]]] = None
 
     # -- connections ----------------------------------------------------------
 
@@ -537,9 +615,100 @@ class KafkaWireClient:
                 c.close()
             self._conns.clear()
 
+    # -- broker compatibility --------------------------------------------------
+
+    def probe_api_versions(self) -> Optional[Dict[int, Tuple[int, int]]]:
+        """ApiVersions (api 18 v0) against the bootstrap broker:
+        ``{api_key: (min, max)}``, or None when the broker won't answer
+        (pre-0.10 brokers close the connection on unknown requests — they
+        ARE this client's era, so no-answer is treated as compatible).
+
+        Uses a throwaway connection: a broker that hangs up on the probe
+        must not poison the cached request connection."""
+        w = Writer()
+        try:
+            conn = _Conn(self.bootstrap[0], self.bootstrap[1],
+                         self.client_id, self.timeout)
+        except OSError:
+            return None  # unreachable: let the real request surface it
+        try:
+            r = conn.request(18, 0, bytes(w.buf))
+            err = r.i16()
+            if err:
+                return None
+            out: Dict[int, Tuple[int, int]] = {}
+            for _ in range(r.i32()):
+                key = r.i16()
+                out[key] = (r.i16(), r.i16())
+            return out
+        except (OSError, KafkaProtocolError):
+            return None  # no/garbled answer: era-compatible broker assumed
+        finally:
+            conn.close()
+
+    def ensure_features(self, feats) -> None:
+        """Register feature groups (API_FEATURES keys) this client will
+        use. Registered before the first connect, they're validated by the
+        connect-time probe; registered after (e.g. the first ``txn()``
+        handle on a live client), they're checked against the cached
+        advertisement immediately."""
+        new = set(feats) - self.features
+        self.features |= set(feats)
+        if new and self._compat_checked:
+            self._validate_features(new)
+
+    @staticmethod
+    def _feature_gaps(feats, advertised) -> List[str]:
+        broken: List[str] = []
+        for feat in sorted(feats):
+            for key, (name, pinned) in API_FEATURES[feat].items():
+                rng = advertised.get(key)
+                missing = [v for v in pinned
+                           if rng is None or not rng[0] <= v <= rng[1]]
+                if missing:
+                    have = ("absent" if rng is None
+                            else f"v{rng[0]}-v{rng[1]}")
+                    broken.append(
+                        f"  [{feat}] {name} (api {key}): need "
+                        f"v{'/v'.join(map(str, missing))}, broker serves {have}")
+        return broken
+
+    def _validate_features(self, feats) -> None:
+        if self._advertised is None:
+            return  # broker didn't answer the probe: era-compatible assumed
+        broken = self._feature_gaps(feats, self._advertised)
+        if broken:
+            raise KafkaProtocolError(
+                "broker is incompatible with this client's 0.10/0.11-era "
+                "protocol pinning (KIP-896 removed legacy versions in "
+                "Kafka 4.0; use a broker <= 3.x or one compatible with the "
+                "reference's Kafka 0.11 era):\n" + "\n".join(broken))
+
+    def check_broker_compat(self) -> None:
+        """Fail LOUDLY if the broker no longer serves a pinned (api,
+        version) of any feature in use — modern brokers removed the
+        0.10/0.11-era encodings (KIP-896), and without this probe that
+        surfaces as a cryptic disconnect on the first produce/fetch.
+        Features NOT in use (e.g. transactions on a plain 0.10 broker)
+        only log a warning, so older brokers keep the core path. Runs once
+        per client, from the first metadata refresh."""
+        self._advertised = self.probe_api_versions()
+        if self._advertised is None:
+            return
+        self._validate_features(self.features)
+        unused = set(API_FEATURES) - self.features
+        gaps = self._feature_gaps(unused, self._advertised)
+        if gaps:
+            logger.info(
+                "broker lacks optional protocol features (fine unless "
+                "enabled later):\n%s", "\n".join(gaps))
+
     # -- metadata -------------------------------------------------------------
 
     def refresh_metadata(self, topics: Optional[List[str]] = None) -> None:
+        if not self._compat_checked:
+            self._compat_checked = True  # once; errors are permanent anyway
+            self.check_broker_compat()
         w = Writer()
         ts = topics or []
         w.i32(len(ts))
@@ -995,6 +1164,7 @@ class GroupMembership:
 
     def __init__(self, client: "KafkaWireClient", group: str,
                  topics: List[str], session_timeout_ms: int = 10000) -> None:
+        client.ensure_features({"group"})
         self.client = client
         self.group = group
         self.topics = list(topics)
@@ -1129,6 +1299,8 @@ class KafkaWireBroker:
         if idempotent and message_format != "v2":
             raise KafkaProtocolError(
                 "idempotent=True requires message_format='v2'")
+        if message_format == "v2":
+            self.client.ensure_features({"batches-v2"})
         self.message_format = message_format
         self.compression = compression
         # KIP-98 idempotent produce: one (producer_id, epoch) per broker
@@ -1286,6 +1458,7 @@ class KafkaTxn:
     def __init__(self, broker: "KafkaWireBroker", txn_id: str) -> None:
         self._broker = broker
         self._client = broker.client
+        self._client.ensure_features({"txn"})
         self.txn_id = txn_id
         self._pid: Optional[int] = None
         self._epoch = -1
@@ -1309,10 +1482,9 @@ class KafkaTxn:
         commit atomically with this transaction's records. Merged max-wins
         across calls within one transaction."""
         assert self._open, "begin() first"
-        dst = self._offsets.setdefault(group, {})
-        for tp, off in offsets.items():
-            if off > dst.get(tp, -1):
-                dst[tp] = off
+        from storm_tpu.runtime.tuples import merge_offsets
+
+        merge_offsets(self._offsets.setdefault(group, {}), offsets.items())
 
     def produce(self, topic: str, value, key=None, partition=None) -> None:
         assert self._open, "begin() first"
@@ -1346,6 +1518,7 @@ class KafkaTxn:
                     self._client.produce(
                         topic, partition, records, acks=-1,
                         message_format="v2",
+                        compression=self._broker.compression,
                         producer=(self._pid, self._epoch, seq),
                         transactional_id=self.txn_id)
                     self._seqs[(topic, partition)] = \

@@ -185,13 +185,14 @@ class MemoryTxn:
         """Stage consumer-group offsets to commit atomically with the
         records (same surface as ``KafkaTxn.send_offsets``)."""
         assert self._open, "begin() first"
-        dst = self._offsets.setdefault(group, {})
-        for tp, off in offsets.items():
-            if off > dst.get(tp, -1):
-                dst[tp] = off
+        from storm_tpu.runtime.tuples import merge_offsets
+
+        merge_offsets(self._offsets.setdefault(group, {}), offsets.items())
 
     def commit(self) -> None:
         assert self._open, "begin() first"
+        from storm_tpu.runtime.tuples import merge_offsets
+
         self._open = False
         with self._broker._lock:
             # all-or-nothing under the broker lock: no fetch interleaves,
@@ -199,10 +200,9 @@ class MemoryTxn:
             for topic, value, key, partition in self._pending:
                 self._broker._produce_locked(topic, value, key, partition)
             for group, offs in self._offsets.items():
-                for (topic, partition), off in offs.items():
-                    key = (group, topic, partition)
-                    if off > self._broker._committed.get(key, -1):
-                        self._broker._committed[key] = off
+                merge_offsets(
+                    self._broker._committed,
+                    (((group, t, p), off) for (t, p), off in offs.items()))
         self._pending.clear()
         self._offsets.clear()
 

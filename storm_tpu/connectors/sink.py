@@ -34,7 +34,7 @@ from typing import Any, Callable, Optional
 from storm_tpu.config import SinkConfig
 from storm_tpu.connectors.memory import MemoryBroker
 from storm_tpu.runtime.base import Bolt, OutputCollector, TopologyContext
-from storm_tpu.runtime.tuples import Tuple
+from storm_tpu.runtime.tuples import Tuple, merge_offsets
 
 log = logging.getLogger("storm_tpu.sink")
 
@@ -303,10 +303,9 @@ class TransactionalBrokerSink(BrokerSink):
                 for t, topic, key, value in batch:
                     self._txn.produce(topic, value, key)
                     if self._offsets_group:
-                        for src_topic, src_part, next_off in t.origins:
-                            tp = (src_topic, src_part)
-                            if next_off > offs.get(tp, -1):
-                                offs[tp] = next_off
+                        merge_offsets(
+                            offs, (((src_t, src_p), off)
+                                   for (src_t, src_p, off) in t.origins))
                 if offs:
                     self._txn.send_offsets(self._offsets_group, offs)
                 self._txn.commit()

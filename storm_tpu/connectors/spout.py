@@ -208,6 +208,16 @@ class BrokerSpout(Spout):
 
     def _apply_seek(self, position) -> None:
         self.replay.clear()
+        if self._txn_mode:
+            # Discarded replay entries will never ack, so their in-flight
+            # counts must not keep gating fetches (permanent partition
+            # stall). Entries still in self.pending WILL complete — rebase
+            # the counters on those alone.
+            counts: Dict[int, int] = {}
+            for mid in self.pending:
+                pp, _ = self._msg_part_off(mid)
+                counts[pp] = counts.get(pp, 0) + 1
+            self._part_inflight = counts
         for p in self.my_partitions:
             if position == "earliest":
                 pos = self.broker.earliest_offset(self.topic, p)
@@ -268,22 +278,26 @@ class BrokerSpout(Spout):
             # (executor catches and retries next_tuple) must re-fetch the
             # unemitted tail — duplicates are the safe direction for
             # at-least-once; a skipped record is not.
+            # txn mode counts AFTER each successful emit: incrementing
+            # before an emit that then raises would gate the partition on
+            # an ack that never comes (the executor's retry re-fetches the
+            # unemitted entry, which must not find the gate closed).
             if self.chunk > 1:
                 # One full-size fetch (one broker round trip), sliced into
                 # chunk tuples — NOT one fetch per chunk, which would
                 # multiply network fetches for blocking brokers.
                 records = list(records)
                 for i in range(0, len(records), self.chunk):
+                    await self._emit_chunk(records[i : i + self.chunk])
                     if self._txn_mode:
                         self._part_inflight[p] = \
                             self._part_inflight.get(p, 0) + 1
-                    await self._emit_chunk(records[i : i + self.chunk])
             else:
                 for rec in records:
+                    await self._emit(rec)
                     if self._txn_mode:
                         self._part_inflight[p] = \
                             self._part_inflight.get(p, 0) + 1
-                    await self._emit(rec)
             self.positions[p] = records[-1].offset + 1
             return True
         return False

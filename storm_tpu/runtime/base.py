@@ -17,7 +17,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from storm_tpu.runtime.groupings import DirectGrouping
-from storm_tpu.runtime.tuples import Tuple, Values, new_id
+from storm_tpu.runtime.tuples import Tuple, Values, merge_offsets, new_id
 
 
 class TopologyContext:
@@ -104,10 +104,8 @@ class OutputCollector:
                 # commit).
                 acc: dict = {}
                 for a in anchor_list:
-                    for (src_t, src_p, off) in a.origins:
-                        k = (src_t, src_p)
-                        if off > acc.get(k, -1):
-                            acc[k] = off
+                    merge_offsets(acc, (((src_t, src_p), off)
+                                        for (src_t, src_p, off) in a.origins))
                 origins = frozenset(
                     (src_t, src_p, off) for (src_t, src_p), off in acc.items())
         else:

@@ -55,6 +55,17 @@ class Values(list):
     """An emitted value list, mirroring Storm's ``Values`` for familiarity."""
 
 
+def merge_offsets(dst: dict, items) -> dict:
+    """Max-wins merge of ``(key, offset)`` pairs into ``dst`` — THE offset
+    fold of the exactly-once chain (origins union, ``send_offsets``
+    staging, the transactional sink's commit). One implementation so the
+    accounting can never diverge between sites."""
+    for k, off in items:
+        if off > dst.get(k, -1):
+            dst[k] = off
+    return dst
+
+
 from functools import lru_cache
 
 

@@ -31,6 +31,12 @@ class KafkaStubBroker:
     #: exercises the client's v2 decode over a real socket
     serve_batches = False
 
+    #: ApiVersions (api 18) behavior: None = advertise every version the
+    #: client pins (compatible broker); a dict {api: (min, max)} simulates
+    #: a broker with a different surface (e.g. post-KIP-896 removals);
+    #: "closed" = hang up on the probe like a pre-0.10 broker.
+    api_versions: "dict | str | None" = None
+
     def __init__(self, partitions: int = 2) -> None:
         self.partitions = partitions
         self._logs: Dict[Tuple[str, int], List[Tuple[Optional[bytes], bytes, float]]] = {}
@@ -132,6 +138,8 @@ class KafkaStubBroker:
     # ---- api dispatch --------------------------------------------------------
 
     def _dispatch(self, api: int, version: int, r: Reader) -> bytes:
+        if api == 18:
+            return self._api_versions(r)
         if api == 3:
             return self._metadata(r)
         if api == 0:
@@ -165,6 +173,23 @@ class KafkaStubBroker:
         if api == 28:
             return self._txn_offset_commit(r)
         raise RuntimeError(f"stub does not implement api {api}")
+
+    def _api_versions(self, r: Reader) -> bytes:
+        if self.api_versions == "closed":
+            raise OSError("simulated pre-0.10 broker: hang up on probe")
+        if self.api_versions is None:
+            from storm_tpu.connectors.kafka_protocol import PINNED_API_VERSIONS
+            ranges = {key: (min(vs), max(vs))
+                      for key, (_n, vs) in PINNED_API_VERSIONS.items()}
+            ranges[18] = (0, 0)
+        else:
+            ranges = self.api_versions
+        w = Writer()
+        w.i16(0)  # error
+        w.i32(len(ranges))
+        for key, (lo, hi) in sorted(ranges.items()):
+            w.i16(key).i16(lo).i16(hi)
+        return bytes(w.buf)
 
     def _metadata(self, r: Reader) -> bytes:
         n = r.i32()

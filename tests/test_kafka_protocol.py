@@ -196,12 +196,12 @@ def test_gzip_wrapper_message_decode():
     assert [r.offset for r in recs] == [105, 106, 107]
     assert recs[2].key == b"k"
 
-    # unsupported codec (lz4=3) still raises; snappy now decodes (below)
+    # unsupported codec (zstd=4) still raises; gzip/snappy/lz4 all decode
     from storm_tpu.connectors.kafka_protocol import KafkaProtocolError
 
     msg2 = Writer()
     msg2.i8(1)
-    msg2.i8(3)  # lz4
+    msg2.i8(4)  # zstd
     msg2.i64(0)
     msg2.bytes_(None)
     msg2.bytes_(b"xx")
@@ -899,3 +899,157 @@ def test_txn_policy_orders_per_partition(run):
         assert (1, 1) in col.emitted
 
     run(go(), timeout=10)
+
+
+def test_lz4_block_decode_and_frame_roundtrip():
+    """LZ4 decoder validated against hand-crafted block streams (literals,
+    backref matches, overlapping RLE copies) independently of our encoder;
+    frame round-trip through the literal-only encoder; corrupt streams
+    fail loudly. xxh32 (frame header checksum) checked against published
+    test vectors inside the module tests below."""
+    from storm_tpu.connectors.lz4 import (Lz4Error, _xxh32, compress_frame,
+                                          decompress_block, decompress_frame)
+
+    # known xxh32 vectors (seed 0)
+    assert _xxh32(b"") == 0x02CC5D05
+    assert _xxh32(b"a") == 0x550D7456
+    assert _xxh32(b"abc") == 0x32D153FF
+
+    # literal 'abcd' + match len 8 off 4 (overlapping) -> 'abcdabcdabcd'
+    blk = bytes([(4 << 4) | (8 - 4)]) + b"abcd" + bytes([4, 0])
+    assert decompress_block(blk) == b"abcdabcdabcd"
+
+    # extended lengths: 20 literals (15+5), then match len 23 (15+4+4)
+    lit = bytes(range(20))
+    blk2 = bytes([(15 << 4) | 15]) + bytes([5]) + lit + bytes([20, 0, 4])
+    assert decompress_block(blk2) == lit + (lit * 2)[:23]
+
+    # non-overlapping 2-byte offset match
+    lit3 = b"0123456789" * 7  # 70 bytes
+    blk3 = (bytes([(15 << 4) | (10 - 4)]) + bytes([70 - 15]) + lit3
+            + bytes([70, 0]))
+    assert decompress_block(blk3) == lit3 + lit3[:10]
+
+    data = b"storm-tpu lz4 " * 500
+    assert decompress_frame(compress_frame(data)) == data
+
+    with pytest.raises(Lz4Error):
+        decompress_block(bytes([(4 << 4)]) + b"ab")  # truncated literals
+    with pytest.raises(Lz4Error):
+        decompress_block(bytes([(0 << 4) | 0, 9, 0]))  # offset past output
+    with pytest.raises(Lz4Error):
+        decompress_frame(b"\x00\x01\x02\x03\x04\x05\x06\x07")  # bad magic
+    with pytest.raises(Lz4Error):
+        decompress_frame(compress_frame(data)[:-6])  # truncated block
+
+
+def test_lz4_wrapper_message_and_batch_decode():
+    """Both fetch decode paths read lz4: a v1 wrapper message (codec 3,
+    KIP-31 relative inner offsets) and a v2 record batch (codec bits 3) —
+    the last 0.11-era producer codec the ingest path was missing
+    (reference pom.xml:55-78)."""
+    import struct as _struct
+
+    from storm_tpu.connectors.kafka_protocol import (decode_message_set,
+                                                     encode_record_batch)
+    from storm_tpu.connectors.lz4 import compress_frame
+
+    # ---- v0/v1 wrapper: inner message set, lz4-framed, codec attrs=3 ----
+    inner = encode_message_set([(None, b"in0"), (None, b"in1")], 1234,
+                               offsets=[0, 1])
+    compressed = compress_frame(inner)
+    msg = bytearray()
+    msg.append(1)   # magic 1
+    msg.append(3)   # attributes: lz4
+    msg += _struct.pack(">q", 1234)
+    msg += _struct.pack(">i", -1)  # null key
+    msg += _struct.pack(">i", len(compressed)) + compressed
+    import zlib as _zlib
+    full = bytearray()
+    full += _struct.pack(">q", 11)  # wrapper offset = last inner (KIP-31)
+    full += _struct.pack(">i", 4 + len(msg))
+    full += _struct.pack(">I", _zlib.crc32(bytes(msg)) & 0xFFFFFFFF)
+    full += msg
+    recs = decode_message_set("t", 0, bytes(full))
+    assert [(r.offset, r.value) for r in recs] == [(10, b"in0"), (11, b"in1")]
+
+    # ---- v2 record batch with codec bits 3 ----
+    batch = encode_record_batch([(b"k", b"v0"), (None, b"v1")], 5678,
+                                compression="lz4")
+    out = decode_message_set("t", 1, batch)
+    assert [(r.key, r.value) for r in out] == [(b"k", b"v0"), (None, b"v1")]
+
+
+def test_lz4_record_batch_over_sockets(stub):
+    """End-to-end over real sockets: a producer shipping lz4 record batches
+    delivers intact records back on fetch (stub parses through the shared
+    decode path)."""
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+    stub.serve_batches = True
+    b = KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2",
+                        compression="lz4")
+    try:
+        for i in range(5):
+            b.produce("lz", f"lz4-{i}", partition=0)
+        got = [r.value.decode() for r in b.fetch("lz", 0, 0)]
+        assert got == [f"lz4-{i}" for i in range(5)], got
+    finally:
+        b.close()
+        stub.serve_batches = False
+
+
+def test_api_versions_probe_and_compat(stub):
+    """The connect-time ApiVersions probe: a broker advertising the pinned
+    surface passes; one that dropped the legacy versions (KIP-896-era)
+    fails LOUDLY with a per-api compatibility matrix; one that hangs up on
+    the probe (pre-0.10) is assumed era-compatible."""
+    from storm_tpu.connectors.kafka_protocol import PINNED_API_VERSIONS
+
+    # happy path: stub advertises everything we pin
+    c1 = KafkaWireClient(f"127.0.0.1:{stub.port}")
+    try:
+        advertised = c1.probe_api_versions()
+        assert advertised is not None and 0 in advertised
+        c1.check_broker_compat()  # no raise
+        c1.refresh_metadata(["t"])  # probe integrated into first metadata
+    finally:
+        c1.close()
+
+    # modern broker: legacy produce/fetch versions removed
+    stub.api_versions = {key: (9, 17) for key in PINNED_API_VERSIONS}
+    c2 = KafkaWireClient(f"127.0.0.1:{stub.port}")
+    try:
+        with pytest.raises(KafkaProtocolError) as ei:
+            c2.refresh_metadata(["t"])
+        msg = str(ei.value)
+        assert "KIP-896" in msg and "Produce (api 0)" in msg \
+            and "broker serves v9-v17" in msg
+    finally:
+        c2.close()
+        stub.api_versions = None
+
+    # pre-0.10 broker: connection dropped on the probe -> compatible
+    stub.api_versions = "closed"
+    c3 = KafkaWireClient(f"127.0.0.1:{stub.port}")
+    try:
+        assert c3.probe_api_versions() is None
+        c3.refresh_metadata(["t"])  # proceeds, no raise
+    finally:
+        c3.close()
+        stub.api_versions = None
+
+    # genuine 0.10 broker: core apis served, NO transaction apis. The core
+    # path must work (feature-aware check, not all-or-nothing); asking for
+    # a transaction handle then fails loudly with the [txn] matrix.
+    stub.api_versions = {0: (0, 2), 1: (0, 3), 2: (0, 1), 3: (0, 2),
+                         8: (0, 2), 9: (0, 1), 10: (0, 0), 18: (0, 0)}
+    c4 = KafkaWireBroker(f"127.0.0.1:{stub.port}")  # message_format v1
+    try:
+        c4.client.refresh_metadata(["t"])  # core OK, no raise
+        with pytest.raises(KafkaProtocolError) as ei:
+            c4.txn("t-0")
+        assert "[txn]" in str(ei.value) and "EndTxn" in str(ei.value)
+    finally:
+        c4.close()
+        stub.api_versions = None
