@@ -33,6 +33,10 @@ import numpy as np
 
 # Peak dense bf16 on one TPU v5e (v5 lite) chip. MFU = achieved/peak.
 PEAK_BF16_FLOPS = 197e12
+# v5e HBM2 bandwidth (public spec: 16GB @ 819 GB/s). The roofline ridge
+# sits at PEAK/BW ~= 240 FLOP/byte: configs below it are memory-bound and
+# their MFU ceiling is arithmetic_intensity / 240, not 100%.
+PEAK_HBM_BYTES_PER_S = 819e9
 
 CONFIGS = {
     "lenet5": dict(model="lenet5", input_shape=(28, 28, 1), num_classes=10,
@@ -140,25 +144,32 @@ def timed_device_loop(eng, xd, iters=30, warmup=3):
     return timed_chained(loop, (eng.params, eng.state, xd), iters)
 
 
-def flops_of(eng, xd):
-    """XLA's own cost analysis for one forward (flops per execution)."""
+def cost_of(eng, xd):
+    """XLA's own cost analysis for one forward: (flops, bytes_accessed)
+    per execution. bytes_accessed is post-fusion HBM traffic — params +
+    non-fused activations — the numerator of the memory-roofline bound."""
     try:
         cost = eng._fwd.lower(
             eng.params, eng.state, xd).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
-        return float(cost.get("flops", 0.0)) if cost else 0.0
+        if not cost:
+            return 0.0, 0.0
+        return (float(cost.get("flops", 0.0)),
+                float(cost.get("bytes accessed", 0.0)))
     except Exception as e:  # pragma: no cover - backend-dependent
         log(f"  cost_analysis unavailable: {e!r}")
-        return 0.0
+        return 0.0, 0.0
 
 
-def bench_config(name, iters, weights="float"):
-    cfg = CONFIGS[name]
+def bench_config(name, iters, weights="float", batch=0):
+    cfg = dict(CONFIGS[name])
+    if batch:
+        cfg["batch"] = batch
     eng, xd = build_fwd(cfg, weights=weights)
     per_step = timed_device_loop(eng, xd, iters=iters)
     imgs = cfg["batch"] / per_step
-    flops = flops_of(eng, xd)
+    flops, hbm_bytes = cost_of(eng, xd)
     achieved = flops / per_step if flops else 0.0
     mfu = achieved / PEAK_BF16_FLOPS
     row = {
@@ -170,9 +181,29 @@ def bench_config(name, iters, weights="float"):
         "achieved_tflops": round(achieved / 1e12, 2),
         "mfu_pct": round(100 * mfu, 1),
     }
+    if flops and hbm_bytes:
+        # Roofline: a step can't be faster than the larger of its
+        # compute-bound and memory-bound times. pct_of_roofline says how
+        # much of the HARDWARE ceiling (not the naive 100% MFU) this
+        # config achieves; 'bound' names which wall it sits against.
+        t_compute = flops / PEAK_BF16_FLOPS
+        t_memory = hbm_bytes / PEAK_HBM_BYTES_PER_S
+        t_roof = max(t_compute, t_memory)
+        intensity = flops / hbm_bytes
+        row.update({
+            "hbm_gbytes_per_fwd": round(hbm_bytes / 1e9, 4),
+            "arith_intensity_flop_per_byte": round(intensity, 1),
+            "bound": "compute" if t_compute >= t_memory else "memory",
+            "roofline_ms": round(t_roof * 1e3, 3),
+            "mfu_ceiling_pct": round(100 * min(
+                1.0, intensity / (PEAK_BF16_FLOPS / PEAK_HBM_BYTES_PER_S)), 1),
+            "pct_of_roofline": round(100 * t_roof / per_step, 1),
+        })
     log(f"{row['config']:>22}: {row['step_ms']:8.2f} ms/step  "
         f"{row['images_per_sec']:>9.0f} img/s  "
-        f"{row['achieved_tflops']:6.2f} TFLOP/s  MFU {row['mfu_pct']:4.1f}%")
+        f"{row['achieved_tflops']:6.2f} TFLOP/s  MFU {row['mfu_pct']:4.1f}%"
+        + (f"  [{row['bound']}-bound, {row['pct_of_roofline']:.0f}% of "
+           f"roofline]" if "bound" in row else ""))
     return row
 
 
@@ -234,6 +265,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="", choices=[""] + sorted(CONFIGS))
     ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=0,
+                    help="override the config's device batch size")
     ap.add_argument("--ab", action="store_true",
                     help="Pallas-vs-XLA A/B for the kernel-bearing configs")
     ap.add_argument("--attn-sweep", action="store_true",
@@ -264,7 +297,8 @@ def main() -> None:
                 results.append(bench_config("vit_b16", args.iters, weights=w))
     else:
         for n in names:
-            results.append(bench_config(n, args.iters, weights=args.weights))
+            results.append(bench_config(n, args.iters, weights=args.weights,
+                                        batch=args.batch))
     print(json.dumps(results))
 
 

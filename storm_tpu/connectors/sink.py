@@ -113,6 +113,15 @@ class BrokerSink(Bolt):
             context.component_id, "e2e_latency_ms"
         )
         self._delivered = context.metrics.counter(context.component_id, "delivered")
+        # Latency-decomposition stage: broker produce/confirm time.
+        self._m_produce = context.metrics.histogram(
+            context.component_id, "produce_ms")
+
+    async def _timed_send(self, topic: str, value: bytes,
+                          key: Optional[bytes]) -> None:
+        t0 = time.perf_counter()
+        await self.producer.send(topic, value, key)
+        self._m_produce.observe((time.perf_counter() - t0) * 1e3)
 
     # ---- mapping (FieldNameBasedTupleToKafkaMapper semantics) ----------------
 
@@ -157,7 +166,7 @@ class BrokerSink(Bolt):
             self._ack_delivered(t)
         elif mode == "sync":
             try:
-                await self.producer.send(topic, value, key)
+                await self._timed_send(topic, value, key)
             except Exception as e:
                 self.collector.report_error(e)
                 self.collector.fail(t)
@@ -180,7 +189,7 @@ class BrokerSink(Bolt):
         self, t: Tuple, topic: str, value: bytes, key: Optional[bytes]
     ) -> None:
         try:
-            await self.producer.send(topic, value, key)
+            await self._timed_send(topic, value, key)
         except Exception as e:
             self.collector.report_error(e)
             self.collector.fail(t)

@@ -1,5 +1,6 @@
 from storm_tpu.infer.engine import (
     InferenceEngine,
+    NullEngine,
     set_engine_cache_limit,
     shared_engine,
     unload_engine,
@@ -8,6 +9,7 @@ from storm_tpu.infer.operator import InferenceBolt
 
 __all__ = [
     "InferenceEngine",
+    "NullEngine",
     "shared_engine",
     "unload_engine",
     "set_engine_cache_limit",

@@ -27,7 +27,10 @@ from storm_tpu.config import BatchConfig
 class BatchItem:
     payload: Any  # opaque per-record context (the runtime tuple)
     data: np.ndarray  # (n_i, *instance_shape)
-    ts: float
+    ts: float  # deadline clock: root (append) time when known
+    # batcher-entry time (always perf_counter-now at add): what the
+    # batch-wait stage of the latency decomposition is measured from
+    enq: float = 0.0
 
 
 @dataclass
@@ -74,8 +77,9 @@ class MicroBatcher:
         flushed: Optional[Batch] = None
         if self._count and self._count + n > self.cfg.max_batch:
             flushed = self._take()
+        now = time.perf_counter()
         self._items.append(
-            BatchItem(payload, data, ts if ts is not None else time.perf_counter())
+            BatchItem(payload, data, ts if ts is not None else now, now)
         )
         self._count += n
         if self._count >= self.cfg.max_batch:

@@ -511,6 +511,30 @@ def shared_engine(
     return engine
 
 
+class NullEngine:
+    """Device-free engine: ``predict`` returns a uniform distribution
+    instantly. Plugs into ``InferenceBolt(engine=NullEngine(...))`` to
+    measure the FRAMEWORK's share of the Kafka->Kafka path — broker
+    queueing, spout fetch/decode, batching, executor hops, encode,
+    produce — with device time pinned to zero (the evidence behind the
+    <50 ms framework-overhead claim; bench.py --latency-breakdown).
+
+    Not a mock of the full InferenceEngine surface — just the protocol the
+    operator uses: ``input_shape``, ``warmup``, ``predict``."""
+
+    def __init__(self, input_shape: Tuple[int, ...], num_classes: int) -> None:
+        self.input_shape = tuple(input_shape)
+        self.num_classes = int(num_classes)
+
+    def warmup(self, buckets=None) -> None:  # no device, nothing to compile
+        pass
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        n = x.shape[0]
+        return np.full((n, self.num_classes), 1.0 / self.num_classes,
+                       np.float32)
+
+
 def unload_engine(engine: InferenceEngine) -> bool:
     """Drop ``engine`` from the process cache so its HBM can be reclaimed
     once no bolt references it (live model swaps otherwise accumulate

@@ -118,6 +118,12 @@ class InferenceBolt(Bolt):
         self._m_device_ms = m.histogram(cid, "device_ms")
         self._m_dead = m.counter(cid, "dead_lettered")
         self._m_infer = m.counter(cid, "instances_inferred")
+        # Latency-decomposition stages (bench.py --latency-breakdown): the
+        # e2e append->deliver clock attributed into where time actually
+        # goes. decode_ms/encode_ms come from span(); these cover the gaps.
+        self._m_ingest = m.histogram(cid, "ingest_lag_ms")  # append -> bolt
+        self._m_batch_wait = m.histogram(cid, "batch_wait_ms")  # in batcher
+        self._m_disp_wait = m.histogram(cid, "dispatch_wait_ms")  # sem queue
 
     # ---- ingest --------------------------------------------------------------
 
@@ -188,6 +194,10 @@ class InferenceBolt(Bolt):
             )
 
     async def execute(self, t: Tuple) -> None:
+        if t.root_ts:
+            # Stage 1 of the decomposition: broker append -> bolt arrival
+            # (broker queueing + spout fetch/decode + inter-operator hop).
+            self._m_ingest.observe((time.perf_counter() - t.root_ts) * 1e3)
         payload = t.get("message")
         if isinstance(payload, (list, tuple)):
             await self._execute_chunk(t, payload)
@@ -246,7 +256,17 @@ class InferenceBolt(Bolt):
         # NB: _eager_pending is decremented by a done-callback on the eager
         # task (see _kick_flush), NOT here — a cancel while parked on the
         # semaphore (or before the first step) must still restore it.
+        t0 = time.perf_counter()
+        # Stage: accumulation in the batcher (deadline vs fill), per
+        # record from batcher entry to flush. Observed BEFORE the
+        # semaphore so batch_wait and dispatch_queue partition the clock
+        # instead of overlapping.
+        for it in batch.items:
+            if it.enq:
+                self._m_batch_wait.observe((t0 - it.enq) * 1e3)
         await self._dispatch_sem.acquire()
+        # Stage: wait for a free device slot (max_inflight backpressure).
+        self._m_disp_wait.observe((time.perf_counter() - t0) * 1e3)
         task = asyncio.get_running_loop().create_task(self._run_batch(batch))
         self._inflight.add(task)
         task.add_done_callback(self._inflight.discard)
@@ -262,8 +282,11 @@ class InferenceBolt(Bolt):
             self._m_infer.inc(batch.size)
             for item, preds in batch.split(out):
                 anchor = self._anchor_of(item)
+                with span(self.context.metrics, self.context.component_id,
+                          "encode"):
+                    msg = encode_predictions(preds)
                 await self.collector.emit(
-                    Values([encode_predictions(preds), *self._extras(anchor)]),
+                    Values([msg, *self._extras(anchor)]),
                     anchors=[anchor],
                 )
                 self._complete(item, True)

@@ -36,7 +36,14 @@ class AutoscalePolicy:
     high_ms: float = 200.0
     low_ms: float = 50.0
     min_parallelism: int = 1
-    max_parallelism: int = 16
+    # Default encodes the MEASURED inversion, not Storm intuition: in
+    # front of a batching accelerator, operator parallelism is pipelining
+    # depth — 8 bolts benched ~15% SLOWER than 1 (each task's deadline
+    # flushes fragmented micro-batches; BENCH_NOTES round 2). Past ~2-3
+    # tasks more parallelism HURTS, so the cap sits where pipelining still
+    # wins. Raise it only for non-batching (CPU-bound) bolts, where
+    # Storm's more-executors-more-throughput model actually applies.
+    max_parallelism: int = 3
     interval_s: float = 5.0
     cooldown: int = 3  # consecutive calm checks before scaling down
 
