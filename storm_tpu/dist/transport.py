@@ -50,11 +50,22 @@ def encode_tuple(t: Tuple, now: float) -> list:
         str(t.edge_id),
         [str(a) for a in t.anchors],
         now - t.root_ts,  # age, rebased on arrival
+        # Source-log provenance (exactly-once offsets): without it a
+        # transactional sink placed on ANOTHER worker would see empty
+        # origins and silently never commit offsets. Log offsets are
+        # sequential positions (nowhere near 2^53), so plain JSON ints
+        # are lossless — unlike the random 64-bit ids above.
+        [[tp, p, off] for tp, p, off in t.origins],
     ]
 
 
 def decode_tuple(enc: list, now: float) -> Tuple:
-    values, fields, stream, src, src_task, edge, anchors, age = enc
+    # Tolerant unpack: a worker built from a pre-origins checkout ships an
+    # 8-element envelope — degrade to empty origins (EOS disabled for that
+    # sender's tuples) instead of erroring the whole Deliver RPC and
+    # wedging every tree from it into timeout/replay.
+    values, fields, stream, src, src_task, edge, anchors, age = enc[:8]
+    origins = enc[8] if len(enc) > 8 else []
     return Tuple(
         values=values,
         fields=tuple(fields),
@@ -64,6 +75,7 @@ def decode_tuple(enc: list, now: float) -> Tuple:
         edge_id=int(edge),
         anchors=frozenset(int(a) for a in anchors),
         root_ts=now - age,
+        origins=frozenset((tp, p, off) for tp, p, off in origins),
     )
 
 

@@ -42,6 +42,9 @@ def test_tuple_envelope_roundtrip():
         edge_id=(7 << 56) | 12345,
         anchors=frozenset({(2 << 56) | 999}),
         root_ts=time.perf_counter() - 0.25,
+        # EOS provenance must survive the hop: a transactional sink on
+        # another worker commits offsets from these
+        origins=frozenset({("src", 0, 17), ("src", 3, 42)}),
     )
     payload = transport.encode_deliveries([("bolt", 0, t)])
     [(comp, task, back)] = transport.decode_deliveries(payload)
@@ -49,6 +52,7 @@ def test_tuple_envelope_roundtrip():
     assert back.values == ["hello"]
     assert back.edge_id == t.edge_id
     assert back.anchors == t.anchors
+    assert back.origins == t.origins
     # age-rebased root_ts: within a few ms of the original span
     assert abs((time.perf_counter() - back.root_ts) - 0.25) < 0.05
 
@@ -390,5 +394,90 @@ def test_transactional_sink_over_wire_broker():
             await cluster.shutdown()
 
         asyncio.new_event_loop().run_until_complete(go())
+    finally:
+        stub.close()
+
+
+@pytest.mark.slow
+def test_dist_exactly_once_offsets_in_transaction():
+    """End-to-end exactly-once ACROSS WORKER PROCESSES: spout (policy
+    'txn', worker 0) -> inference (worker 1) -> TransactionalBrokerSink
+    (worker 2) committing the consumed offsets inside the producer
+    transaction. The tuple's source provenance must survive two gRPC hops
+    (transport envelope `origins` field) for the sink to commit anything —
+    a clean run delivers every record exactly once and the group offsets
+    cover the whole input log atomically with the output records."""
+    stub = KafkaStubBroker(partitions=2)
+    try:
+        cfg = Config()
+        cfg.broker.kind = "kafka"
+        cfg.broker.bootstrap = f"127.0.0.1:{stub.port}"
+        cfg.broker.message_format = "v2"
+        cfg.broker.input_topic = "eos-in"
+        cfg.broker.output_topic = "eos-out"
+        cfg.broker.dead_letter_topic = "eos-dlq"
+        cfg.model.name = "lenet5"
+        cfg.model.dtype = "float32"
+        cfg.model.input_shape = (28, 28, 1)
+        cfg.offsets.policy = "txn"
+        cfg.offsets.group_id = "dist-eos"
+        cfg.offsets.max_behind = None
+        cfg.sink.mode = "transactional"
+        cfg.sink.txn_batch = 4
+        cfg.sink.txn_ms = 30.0
+        cfg.sink.offsets_group = "dist-eos"
+        cfg.batch.max_batch = 8
+        cfg.batch.max_wait_ms = 20
+        cfg.batch.buckets = (8,)
+        cfg.topology.spout_parallelism = 1
+        cfg.topology.inference_parallelism = 1
+        cfg.topology.sink_parallelism = 1
+        cfg.topology.message_timeout_s = 60.0
+
+        placement = {
+            "kafka-spout": 0,
+            "inference-bolt": 1,
+            "kafka-bolt": 2,
+            "dlq-bolt": 2,
+        }
+        n_msgs = 10
+        rng = np.random.RandomState(1)
+        with DistCluster(3, env={"JAX_PLATFORMS": "cpu",
+                                 "STORM_TPU_PLATFORM": "cpu"}) as cluster:
+            cluster.submit("dist-eos", cfg, placement)
+
+            from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+            producer = KafkaWireBroker(cfg.broker.bootstrap,
+                                       message_format="v2")
+            for i in range(n_msgs):
+                x = rng.rand(1, 28, 28, 1).astype(np.float32)
+                producer.produce("eos-in",
+                                 json.dumps({"instances": x.tolist()}),
+                                 partition=i % 2)
+
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if stub.topic_size("eos-out") >= n_msgs:
+                    break
+                time.sleep(0.1)
+            assert cluster.drain(timeout_s=30)
+            snap = cluster.metrics()
+            replays = snap["kafka-spout"].get("tree_failed", 0)
+            out = stub.topic_size("eos-out")
+            committed = {
+                p: producer.committed("dist-eos", "eos-in", p)
+                for p in (0, 1)
+            }
+            if replays == 0:
+                # exactly once: every record delivered once, and the
+                # consumed offsets committed atomically with them
+                assert out == n_msgs, (out, committed)
+                assert committed == {0: 5, 1: 5}, committed
+                assert snap["kafka-bolt"]["txn_commits"] >= 1
+                assert snap["kafka-bolt"].get("txn_aborts", 0) == 0
+            else:  # pragma: no cover - transient transport failure path
+                assert out >= n_msgs
+            producer.close()
     finally:
         stub.close()
