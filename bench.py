@@ -317,6 +317,20 @@ def offer_load(produce_nth, rate, seconds, backlog_fn=None,
         if backlog_fn is not None and now - last_check >= check_interval:
             last_check = now
             backlog = backlog_fn(sent)
+            # Absolute depth guard: >2.5s of offered work queued means
+            # the percentiles measure queueing, not service — saturation
+            # regardless of jitter. The monotonic-growth check below
+            # misses slow creep when deliveries arrive in bursts (each
+            # burst resets the streak) — heavy-decode configs integrated
+            # seconds of queueing while reporting valid. Healthy runs sit
+            # far below this (backlog < a deadline-batch or two).
+            if backlog > max(rate * 2.5, 8):
+                # count floor of 8 only filters deadline-batch jitter at
+                # tiny rates; anything higher would re-weaken the bound
+                # exactly where per-message queueing delay is largest
+                log(f"  backlog guard tripped: {backlog} msgs queued "
+                    f"(>2.5s of offered work) @ {rate:.0f} msg/s")
+                return sent, True
             # Only count growth beyond jitter: one deadline-batch of
             # messages can legitimately sit in flight.
             if backlog > prev_backlog and backlog > rate * check_interval * 2:

@@ -105,3 +105,34 @@ def test_stage_list_matches_operator_histograms():
         base = hist[: -len("_ms")]
         quoted = (f'"{hist}"', f"'{hist}'", f'"{base}"', f"'{base}'")
         assert any(q in source for q in quoted), f"stage {hist} not recorded"
+
+
+def test_offer_load_depth_guard_catches_bursty_saturation():
+    """The absolute queue-depth guard: a backlog that OSCILLATES (bursty
+    deliveries reset the monotonic-growth streak) but holds above 2.5s of
+    offered work must abort — the saturation shape that produced 'valid'
+    multi-second percentiles for heavy-payload configs before the fix."""
+    calls = {"n": 0}
+
+    def sawtooth_backlog(sent):
+        calls["n"] += 1
+        # oscillate between 3s and 4s of offered work: growth streak
+        # resets every other check, depth stays above the 2.5s bound
+        return int(100 * 2.5 * (1.2 + 0.3 * (calls["n"] % 2)))
+
+    sent, aborted = bench.offer_load(
+        lambda i: None, rate=100.0, seconds=3.0,
+        backlog_fn=sawtooth_backlog,
+        guard_checks=12, check_interval=0.05)
+    assert aborted
+    assert calls["n"] <= 3  # first depth check trips it
+
+
+def test_offer_load_depth_guard_time_based_at_low_rates():
+    """At low rates the bound must stay TIME-based (2.5s of work), not a
+    fixed count — 50 queued messages at 2 msg/s is 25s of queueing."""
+    sent, aborted = bench.offer_load(
+        lambda i: None, rate=4.0, seconds=3.0,
+        backlog_fn=lambda sent: 12,  # 3s of work at 4 msg/s
+        guard_checks=12, check_interval=0.05)
+    assert aborted

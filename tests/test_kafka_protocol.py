@@ -1053,3 +1053,37 @@ def test_api_versions_probe_and_compat(stub):
     finally:
         c4.close()
         stub.api_versions = None
+
+
+def test_lz4_multiblock_frame_roundtrip():
+    """Frames larger than one block: block boundaries must reassemble
+    exactly, and truncating at a boundary fails loudly."""
+    from storm_tpu.connectors.lz4 import Lz4Error, compress_frame, decompress_frame
+
+    data = bytes(range(256)) * 2048  # 512KB
+    framed = compress_frame(data, block_size=64 * 1024)  # 8 blocks
+    assert decompress_frame(framed) == data
+    with pytest.raises(Lz4Error):
+        # drop the EndMark + final block's tail
+        decompress_frame(framed[:-(64 * 1024 + 8)])
+
+
+def test_txn_produce_with_lz4_codec(stub):
+    """Transactional produce honors broker.compression: the committed
+    records round-trip through the stub's shared decode path (codec 3)."""
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+    b = KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2",
+                        compression="lz4")
+    try:
+        txn = b.txn("lz4-txn-0")
+        txn.begin()
+        for i in range(3):
+            txn.produce("lzt", f"tx-{i}", partition=0)
+        txn.send_offsets("lzg", {("src", 0): 3})
+        txn.commit()
+        got = [r.value.decode() for r in b.fetch("lzt", 0, 0)]
+        assert got == ["tx-0", "tx-1", "tx-2"], got
+        assert b.committed("lzg", "src", 0) == 3
+    finally:
+        b.close()
