@@ -84,10 +84,19 @@ def build_longseq(
 @register("longseq_encoder")
 def longseq_encoder(num_classes: int = 10,
                     input_shape: tuple = (2048, 64),
-                    dim: int = 256, depth: int = 4, num_heads: int = 8,
+                    dim: int = 256, depth: int = 4, num_heads: int = 2,
                     mlp_dim: int = 1024) -> ModelDef:
     """Serving-scale long-context config: S=2048 rides the Pallas flash
-    kernel (past the measured crossover) on TPU."""
+    kernel (past the measured crossover) on TPU.
+
+    ``num_heads=2`` => head_dim 128 = the TPU lane width. The flash
+    kernel pads head_dim to 128 lanes, so head_dim 32 (8 heads) wasted
+    3/4 of every vector op — measured on-chip: 5.43 -> 1.84 ms/step
+    (2.95x) at batch 8 just from this alignment (BENCH_DEVICE_r03.json,
+    BENCH_NOTES round 3).
+    Param count is unchanged (attention projections are dim x dim
+    regardless of head count); override via ``ModelConfig.extra`` if you
+    need more heads."""
     return build_longseq("longseq_encoder", num_classes, input_shape,
                          dim, depth, num_heads, mlp_dim)
 
