@@ -256,6 +256,11 @@ class BrokerConfig:
     # Egress codec for kind='kafka' (None = uncompressed); gzip/snappy/lz4,
     # message_format='v2' only. Ingest decodes all three regardless.
     compression: Optional[str] = None
+    # Consumer isolation (kind='kafka'): 'read_committed' fetches via
+    # Fetch v4 (KIP-98) and filters aborted transactions' records — what
+    # an exactly-once pipeline's INPUT side should use when upstream
+    # producers are transactional. Default matches pre-KIP-98 consumers.
+    isolation: str = "read_uncommitted"
 
     def __post_init__(self) -> None:
         if self.kind not in ("memory", "kafka"):
@@ -274,6 +279,10 @@ class BrokerConfig:
             if self.message_format != "v2":
                 raise ValueError(
                     "broker.compression requires broker.message_format='v2'")
+        if self.isolation not in ("read_uncommitted", "read_committed"):
+            raise ValueError(
+                f"broker.isolation must be read_uncommitted|read_committed, "
+                f"got {self.isolation!r}")
 
 
 def _apply_section(target, values: dict) -> None:

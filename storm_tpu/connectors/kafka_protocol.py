@@ -254,6 +254,18 @@ def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
             raise KafkaProtocolError("varint overflow in record batch")
 
 
+def encode_control_batch(control_type: int, producer: Tuple[int, int],
+                         base_offset: int, ts_ms: int) -> bytes:
+    """A KIP-98 transaction marker batch (attrs bit 5): one record whose
+    key is version(i16)+type(i16) — 0=ABORT, 1=COMMIT. Occupies one log
+    offset, exactly like a real broker's marker."""
+    key = struct.pack(">hh", 0, control_type)
+    return encode_record_batch(
+        [(key, b"")], ts_ms, base_offset=base_offset,
+        producer=(producer[0], producer[1], -1), transactional=True,
+        control=True)
+
+
 def encode_record_batch(
     records: List[Tuple[Optional[bytes], bytes]],
     ts_ms: int,
@@ -261,6 +273,7 @@ def encode_record_batch(
     compression: Optional[str] = None,
     producer: Optional[Tuple[int, int, int]] = None,
     transactional: bool = False,
+    control: bool = False,
 ) -> bytes:
     """[(key, value)] -> one RecordBatch (magic 2; ``compression='gzip'``
     gzips the records block, codec bit 1; ``'snappy'`` wraps it in a raw
@@ -292,6 +305,8 @@ def encode_record_batch(
 
     payload = bytes(body)
     attrs = 0x10 if transactional else 0  # bit 4: isTransactional (KIP-98)
+    if control:
+        attrs |= 0x20  # bit 5: isControl (transaction marker)
     if compression == "gzip":
         import gzip as _gzip
 
@@ -339,11 +354,25 @@ def decode_record_batch(topic: str, partition: int, data: bytes,
                         verify_crc: bool = False) -> Tuple[List[Record], int]:
     """One RecordBatch -> (records, bytes consumed). ``data`` starts at
     baseOffset. Control batches (transaction markers) are skipped."""
+    records, consumed, _pid, _ctrl = decode_record_batch_ex(
+        topic, partition, data, verify_crc)
+    return records, consumed
+
+
+def decode_record_batch_ex(
+    topic: str, partition: int, data: bytes, verify_crc: bool = False,
+) -> Tuple[List[Record], int, int, Optional[int]]:
+    """Like :func:`decode_record_batch` but also returns the batch's
+    ``producer_id`` and, for control batches, the marker type (0=ABORT,
+    1=COMMIT; None for data batches) — what read_committed filtering
+    needs (KIP-98: aborted producers' data batches are dropped until
+    their ABORT marker)."""
     r = Reader(data)
     base_offset = r.i64()
     batch_len = r.i32()
     if r.remaining < batch_len:
-        return [], len(data)  # partial trailing batch (broker truncation)
+        # partial trailing batch (broker truncation)
+        return [], len(data), -1, None
     end = r.pos + batch_len
     r.i32()  # partitionLeaderEpoch
     magic = r.i8()
@@ -363,7 +392,7 @@ def decode_record_batch(topic: str, partition: int, data: bytes,
     r.i32()  # lastOffsetDelta
     base_ts = r.i64()
     r.i64()  # maxTimestamp
-    r.i64()  # producerId
+    producer_id = r.i64()
     r.i16()  # producerEpoch
     r.i32()  # baseSequence
     count = r.i32()
@@ -388,6 +417,7 @@ def decode_record_batch(topic: str, partition: int, data: bytes,
             f"unsupported record-batch codec {codec} "
             "(none/gzip/snappy/lz4 supported; zstd is not)")
     records: List[Record] = []
+    control_type: Optional[int] = None
     pos = 0
     for _ in range(count):
         rec_len, pos = _read_varint(payload, pos)
@@ -413,10 +443,56 @@ def decode_record_batch(topic: str, partition: int, data: bytes,
             pos += max(0, hvlen)
         if pos != rec_end:
             pos = rec_end  # tolerate forward-compatible extra fields
-        if not is_control:
+        if is_control:
+            # control record key: version(i16) + type(i16): 0=ABORT,
+            # 1=COMMIT (KIP-98 transaction markers)
+            if control_type is None and key is not None and len(key) >= 4:
+                control_type = struct.unpack(">h", key[2:4])[0]
+        else:
             records.append(Record(topic, partition, base_offset + off_delta,
                                   key, value, (base_ts + ts_delta) / 1e3))
-    return records, end
+    return records, end, producer_id, control_type
+
+
+def filter_read_committed(
+    topic: str, partition: int, data: bytes,
+    aborted: List[Tuple[int, int]],
+) -> List[Record]:
+    """Decode a fetch record-set under ``isolation_level=read_committed``
+    (KIP-98, the KafkaConsumer algorithm): walk batches in offset order,
+    activating each ``(producer_id, first_offset)`` entry from the
+    broker's ``aborted_transactions`` list once the log reaches its
+    ``first_offset``; data batches from an active aborted producer are
+    dropped until that producer's ABORT control marker. v0/v1 message
+    sets (pre-transactions) pass through untouched."""
+    records: List[Record] = []
+    pending = sorted(aborted, key=lambda e: e[1])  # by first_offset
+    idx = 0
+    aborted_pids: set = set()
+    r = Reader(data)
+    while r.remaining >= 12:
+        if not (len(data) - r.pos >= 17 and data[r.pos + 16] == 2):
+            # legacy message set: cannot be transactional
+            records.extend(decode_message_set(
+                topic, partition, data[r.pos:]))
+            break
+        base_offset = struct.unpack_from(">q", data, r.pos)[0]
+        while idx < len(pending) and pending[idx][1] <= base_offset:
+            aborted_pids.add(pending[idx][0])
+            idx += 1
+        batch, consumed, pid, ctrl = decode_record_batch_ex(
+            topic, partition, data[r.pos:])
+        if consumed <= 0:  # pragma: no cover - defensive
+            break
+        r.pos += consumed
+        if ctrl is not None:
+            if ctrl == 0:  # ABORT marker closes the producer's range
+                aborted_pids.discard(pid)
+            continue
+        if pid >= 0 and pid in aborted_pids:
+            continue  # data from an aborted transaction
+        records.extend(batch)
+    return records
 
 
 # ---- connection --------------------------------------------------------------
@@ -505,6 +581,10 @@ API_FEATURES: "Dict[str, Dict[int, Tuple[str, Tuple[int, ...]]]]" = {
         25: ("AddOffsetsToTxn", (0,)),
         26: ("EndTxn", (0,)),
         28: ("TxnOffsetCommit", (0,)),
+    },
+    # isolation_level=read_committed fetches (KIP-98 consumer side)
+    "read-committed": {
+        1: ("Fetch", (4,)),
     },
     # consumer-group coordination (offsets.group_protocol)
     "group": {
@@ -829,14 +909,26 @@ class KafkaWireClient:
         max_bytes: int = 1 << 20,
         max_wait_ms: int = 100,
         min_bytes: int = 1,
+        isolation: str = "read_uncommitted",
     ) -> List[Record]:
+        """``isolation='read_committed'`` uses Fetch v4 (Kafka 0.11,
+        KIP-98): the broker bounds the fetch at the last stable offset and
+        reports aborted-transaction ranges, which are filtered out here —
+        open and aborted transactions' records never reach the caller.
+        The default keeps the v2 path (sees everything, like a pre-KIP-98
+        consumer)."""
+        committed = isolation == "read_committed"
         w = Writer()
         w.i32(-1).i32(max_wait_ms).i32(min_bytes)
+        if committed:
+            w.i32(max_bytes)  # response-level max_bytes (v3+)
+            w.i8(1)  # isolation_level: read_committed
         w.i32(1)
         w.string(topic)
         w.i32(1)
         w.i32(partition).i64(offset).i32(max_bytes)
-        r = self._request(self._leader_addr(topic, partition), 1, 2, bytes(w.buf))
+        r = self._request(self._leader_addr(topic, partition), 1,
+                          4 if committed else 2, bytes(w.buf))
         r.i32()  # throttle
         out: List[Record] = []
         for _ in range(r.i32()):
@@ -845,10 +937,22 @@ class KafkaWireClient:
                 r.i32()  # partition
                 err = r.i16()
                 r.i64()  # high watermark
+                aborted: List[Tuple[int, int]] = []
+                if committed:
+                    r.i64()  # last stable offset
+                    n_aborted = r.i32()
+                    for _ in range(max(0, n_aborted)):  # -1 = null
+                        pid = r.i64()
+                        first = r.i64()
+                        aborted.append((pid, first))
                 data = r.bytes_() or b""
                 if err:
                     raise KafkaProtocolError(f"fetch error code {err}")
-                out.extend(decode_message_set(topic, partition, data))
+                if committed:
+                    out.extend(filter_read_committed(
+                        topic, partition, data, aborted))
+                else:
+                    out.extend(decode_message_set(topic, partition, data))
         # Skip messages below the requested offset (brokers may return the
         # whole containing batch).
         return [rec for rec in out if rec.offset >= offset]
@@ -1294,13 +1398,21 @@ class KafkaWireBroker:
     def __init__(self, bootstrap: str, client_id: str = "storm-tpu",
                  message_format: str = "v1",
                  compression: Optional[str] = None,
-                 idempotent: bool = False) -> None:
+                 idempotent: bool = False,
+                 isolation: str = "read_uncommitted") -> None:
         self.client = KafkaWireClient(bootstrap, client_id)
         if idempotent and message_format != "v2":
             raise KafkaProtocolError(
                 "idempotent=True requires message_format='v2'")
         if message_format == "v2":
             self.client.ensure_features({"batches-v2"})
+        if isolation not in ("read_uncommitted", "read_committed"):
+            raise KafkaProtocolError(
+                f"isolation must be read_uncommitted|read_committed, "
+                f"got {isolation!r}")
+        self.isolation = isolation
+        if isolation == "read_committed":
+            self.client.ensure_features({"read-committed"})
         self.message_format = message_format
         self.compression = compression
         # KIP-98 idempotent produce: one (producer_id, epoch) per broker
@@ -1407,7 +1519,8 @@ class KafkaWireBroker:
             if len(buf) > max_records:
                 self._prefetch[key] = buf[max_records:]
             return buf[:max_records]
-        recs = self.client.fetch(topic, partition, offset)
+        recs = self.client.fetch(topic, partition, offset,
+                                 isolation=self.isolation)
         if len(recs) > max_records:
             self._prefetch[key] = recs[max_records:]
         return recs[:max_records]

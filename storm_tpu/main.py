@@ -123,7 +123,8 @@ def _make_broker(cfg: Config):
         return KafkaWireBroker(cfg.broker.bootstrap,
                                message_format=cfg.broker.message_format,
                                compression=cfg.broker.compression,
-                               idempotent=cfg.broker.idempotent)
+                               idempotent=cfg.broker.idempotent,
+                               isolation=cfg.broker.isolation)
     raise ValueError(f"unknown broker kind {cfg.broker.kind!r}")
 
 

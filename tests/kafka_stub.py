@@ -37,6 +37,15 @@ class KafkaStubBroker:
     #: "closed" = hang up on the probe like a pre-0.10 broker.
     api_versions: "dict | str | None" = None
 
+    #: True = REAL-broker transactional log semantics: transactional
+    #: records append to the log immediately (tagged with their producer
+    #: id) and EndTxn appends a control marker, occupying an offset —
+    #: read_uncommitted fetches see everything, Fetch v4 read_committed
+    #: clients filter via the aborted_transactions ranges the stub
+    #: reports. Default False keeps the simpler buffer-until-commit model
+    #: the rest of the suite uses (nothing visible before commit).
+    log_transactional = False
+
     def __init__(self, partitions: int = 2) -> None:
         self.partitions = partitions
         self._logs: Dict[Tuple[str, int], List[Tuple[Optional[bytes], bytes, float]]] = {}
@@ -58,6 +67,15 @@ class KafkaStubBroker:
         # read-committed visibility; abort drops them. Re-InitProducerId on
         # the same txn_id bumps the epoch (zombie fencing).
         self._txns: Dict[str, dict] = {}
+        # log_transactional mode: per-(topic, partition) list of
+        # (producer_id, first_offset, marker_offset) for ABORTED
+        # transactions; Fetch v4 reports (pid, first_offset) for ranges
+        # whose ABORT marker lies within/after the fetched region — a
+        # range whose marker precedes the fetch offset is history (its
+        # aborted data can't appear in the response), and reporting it
+        # would wrongly re-activate the producer and drop its later
+        # committed records.
+        self._aborted: Dict[Tuple[str, int], List[Tuple[int, int, int]]] = {}
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -145,7 +163,7 @@ class KafkaStubBroker:
         if api == 0:
             return self._produce(r, version)
         if api == 1:
-            return self._fetch(r)
+            return self._fetch(r, version)
         if api == 2:
             return self._list_offsets(r)
         if api == 10:
@@ -228,11 +246,24 @@ class KafkaStubBroker:
                     self._txns[txn_id] = st
                 else:
                     # fencing: bump epoch, drop any half-open transaction
+                    # (log_transactional mode: the fenced txn's appended
+                    # records become an implicit abort range + marker,
+                    # like a real coordinator's bumpEpoch abort)
+                    if self.log_transactional:
+                        for (topic, part), first in \
+                                st.get("first", {}).items():
+                            self._aborted.setdefault(
+                                (topic, part), []).append(
+                                    (st["pid"], first,
+                                     len(self._logs[(topic, part)])))
+                            self._logs[(topic, part)].append(
+                                ("c", 0, time.time(), st["pid"]))
                     st["epoch"] += 1
                     st["pending"] = []
                     st["parts"] = set()
                     st["pending_offsets"] = {}
                     st["offset_groups"] = set()
+                    st["first"] = {}
                 pid, epoch = st["pid"], st["epoch"]
         w = Writer()
         w.i32(0).i16(0).i64(pid).i16(epoch)  # throttle, err, pid, epoch
@@ -330,11 +361,27 @@ class KafkaStubBroker:
         with self._lock:
             st, err = self._txn_check(txn_id, pid, epoch)
             if not err:
-                if commit:
+                if self.log_transactional:
+                    # real-broker semantics: a control marker per touched
+                    # partition, occupying one offset; aborts register the
+                    # (pid, first_offset) range for Fetch v4 filtering
+                    for (topic, part) in sorted(st["parts"]):
+                        self._ensure(topic)
+                        log = self._logs[(topic, part)]
+                        first = st.get("first", {}).get((topic, part))
+                        if not commit and first is not None:
+                            self._aborted.setdefault(
+                                (topic, part), []).append(
+                                    (pid, first, len(log)))
+                        log.append(("c", 1 if commit else 0,
+                                    time.time(), pid))
+                    st["first"] = {}
+                elif commit:
                     for topic, part, key, value in st["pending"]:
                         self._ensure(topic)
                         self._logs[(topic, part)].append(
                             (key, value, time.time()))
+                if commit:
                     # offsets land atomically with the records (KIP-98:
                     # the commit marker covers __consumer_offsets too)
                     for (group, topic, part), off in \
@@ -387,7 +434,10 @@ class KafkaStubBroker:
                     log = self._logs[(topic, pid)]
                     base = len(log)
                     if txn_id is not None:
-                        # transactional: buffer until EndTxn(commit)
+                        # transactional: buffer until EndTxn(commit) — or,
+                        # in log_transactional mode, append immediately
+                        # tagged with the producer id (real-broker
+                        # semantics; visibility is the CONSUMER's job)
                         st = self._txns.get(txn_id)
                         p_pid, _, _, p_epoch = prod if prod else (
                             -1, -1, -1, -1)
@@ -397,6 +447,12 @@ class KafkaStubBroker:
                             err = 47  # INVALID_PRODUCER_EPOCH (fenced)
                         elif (topic, pid) not in st["parts"]:
                             err = 48  # partition not added to the txn
+                        elif self.log_transactional:
+                            st.setdefault("first", {}).setdefault(
+                                (topic, pid), len(log))
+                            for rec in decode_message_set(topic, pid, data):
+                                log.append(("d", rec.key, rec.value,
+                                            time.time(), p_pid))
                         else:
                             for rec in decode_message_set(topic, pid, data):
                                 st["pending"].append(
@@ -419,15 +475,58 @@ class KafkaStubBroker:
                             self._pid_state[key] = (base_seq, count, base)
                     if data:
                         for rec in decode_message_set(topic, pid, data):
-                            log.append((rec.key, rec.value, time.time()))
+                            if self.log_transactional:
+                                # uniform tagged entries in this mode
+                                # (pid -1 = non-transactional data)
+                                log.append(("d", rec.key, rec.value,
+                                            time.time(), -1))
+                            else:
+                                log.append((rec.key, rec.value, time.time()))
                 w.i32(pid).i16(err).i64(base).i64(-1)
         w.i32(0)  # throttle
         return bytes(w.buf)
 
-    def _fetch(self, r: Reader) -> bytes:
+    @staticmethod
+    def _encode_tagged(chunk, offset: int) -> bytes:
+        """log_transactional entries -> record batches: consecutive data
+        records from one producer share a batch; control markers get their
+        own control batch (exactly the shapes a real broker serves)."""
+        from storm_tpu.connectors.kafka_protocol import (
+            encode_control_batch, encode_record_batch)
+
+        out = bytearray()
+        i = 0
+        now_ms = int(time.time() * 1e3)
+        while i < len(chunk):
+            entry = chunk[i]
+            if entry[0] == "c":
+                out += encode_control_batch(entry[1], (entry[3], 0),
+                                            offset + i, now_ms)
+                i += 1
+                continue
+            run = [entry]
+            while (i + len(run) < len(chunk)
+                   and chunk[i + len(run)][0] == "d"
+                   and chunk[i + len(run)][4] == entry[4]):
+                run.append(chunk[i + len(run)])
+            prod_id = entry[4]
+            out += encode_record_batch(
+                [(e[1], e[2]) for e in run], now_ms,
+                base_offset=offset + i,
+                producer=(prod_id, 0, 0) if prod_id >= 0 else None,
+                transactional=prod_id >= 0)
+            i += len(run)
+        return bytes(out)
+
+    def _fetch(self, r: Reader, version: int = 2) -> bytes:
         r.i32()  # replica
         r.i32()  # max wait
         r.i32()  # min bytes
+        if version >= 3:
+            r.i32()  # response-level max_bytes
+        isolation = 0
+        if version >= 4:
+            isolation = r.i8()
         w = Writer()
         w.i32(0)  # throttle
         n_topics = r.i32()
@@ -444,9 +543,27 @@ class KafkaStubBroker:
                 with self._lock:
                     self._ensure(topic)
                     log = self._logs[(topic, pid)]
-                    chunk = log[offset : offset + 256]
                     hw = len(log)
-                if self.serve_batches and chunk:
+                    # LSO = first offset of any OPEN transaction (real
+                    # brokers never serve read_committed past it)
+                    lso = hw
+                    for st in self._txns.values():
+                        first = st.get("first", {}).get((topic, pid))
+                        if first is not None:
+                            lso = min(lso, first)
+                    end = min(offset + 256, lso) if isolation == 1 else \
+                        offset + 256
+                    chunk = log[offset:end]
+                    aborted = [
+                        (a_pid, first)
+                        for a_pid, first, marker in
+                        self._aborted.get((topic, pid), [])
+                        if marker >= offset
+                    ]
+                tagged = bool(chunk) and len(chunk[0]) >= 4
+                if tagged:
+                    msgset = self._encode_tagged(chunk, offset)
+                elif self.serve_batches and chunk:
                     from storm_tpu.connectors.kafka_protocol import (
                         encode_record_batch,
                     )
@@ -463,6 +580,11 @@ class KafkaStubBroker:
                         offsets=list(range(offset, offset + len(chunk))),
                     )
                 w.i32(pid).i16(0).i64(hw)
+                if version >= 4:
+                    w.i64(lso)  # last stable offset
+                    w.i32(len(aborted))
+                    for a_pid, first in aborted:
+                        w.i64(a_pid).i64(first)
                 w.bytes_(msgset)
         return bytes(w.buf)
 

@@ -1087,3 +1087,147 @@ def test_txn_produce_with_lz4_codec(stub):
         assert b.committed("lzg", "src", 0) == 3
     finally:
         b.close()
+
+
+def test_read_committed_filters_aborted_transactions(stub):
+    """Fetch v4 + isolation_level=read_committed (KIP-98, the reference's
+    own Kafka 0.11): with REAL-broker transactional log semantics
+    (records append immediately, EndTxn appends a control marker), a
+    read_committed consumer must see only committed transactions' records
+    — aborted data is filtered via the broker's aborted_transactions
+    ranges — while a read_uncommitted (v2-era) consumer sees everything."""
+    # per-test stub instance: no cross-test leak to undo
+    stub.log_transactional = True
+    good = KafkaWireBroker(f"127.0.0.1:{stub.port}",
+                           message_format="v2", client_id="good")
+    bad = KafkaWireBroker(f"127.0.0.1:{stub.port}",
+                          message_format="v2", client_id="bad")
+    t_good = good.txn("rc-good")
+
+    # interleave: good txn 1, aborted txn, good txn 2 — all partition 0
+    t_good.begin()
+    t_good.produce("rc", b"ok-0", partition=0)
+    t_good.produce("rc", b"ok-1", partition=0)
+    t_good.commit()
+    # the aborting producer ships its records EAGERLY (low-level path:
+    # KafkaTxn only puts buffered records on the wire at commit, so an
+    # abort via the handle leaves nothing at the broker to filter)
+    pid, epoch = bad.client.init_producer_id(transactional_id="rc-bad")
+    bad.client.add_partitions_to_txn("rc-bad", pid, epoch, [("rc", 0)])
+    bad.client.produce("rc", 0, [(None, b"POISON-0"),
+                                 (None, b"POISON-1")], acks=-1,
+                       message_format="v2", producer=(pid, epoch, 0),
+                       transactional_id="rc-bad")
+    bad.client.end_txn("rc-bad", pid, epoch, commit=False)
+    t_good.begin()
+    t_good.produce("rc", b"ok-2", partition=0)
+    t_good.commit()
+
+    # read_uncommitted (v2 era): sees committed AND aborted data
+    all_vals = [r.value for r in good.client.fetch("rc", 0, 0)]
+    assert b"POISON-0" in all_vals and b"ok-2" in all_vals
+
+    # read_committed: aborted records filtered, committed kept, order
+    # and offsets preserved (markers occupy offsets but carry no data)
+    rc = good.client.fetch("rc", 0, 0, isolation="read_committed")
+    assert [r.value for r in rc] == [b"ok-0", b"ok-1", b"ok-2"]
+    offs = [r.offset for r in rc]
+    assert offs == sorted(offs) and offs[0] == 0
+
+    # KafkaWireBroker-level isolation plumbs through fetch()
+    rc_broker = KafkaWireBroker(f"127.0.0.1:{stub.port}",
+                                message_format="v2",
+                                isolation="read_committed")
+    vals = [r.value for r in rc_broker.fetch("rc", 0, 0)]
+    assert vals == [b"ok-0", b"ok-1", b"ok-2"]
+    rc_broker.close()
+    good.close()
+    bad.close()
+
+
+def test_read_committed_bounded_at_open_transaction(stub):
+    """An OPEN transaction's records sit past the LSO: read_committed
+    consumers must not see them (the broker serves nothing beyond the
+    LSO); after commit they appear."""
+    # per-test stub instance: no cross-test leak to undo
+    stub.log_transactional = True
+    b = KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2",
+                        isolation="read_committed")
+    txn = b.txn("rc-open")
+    txn.begin()
+    txn.produce("rco", b"inflight", partition=0)
+    # KafkaTxn buffers locally; push the records to the broker inside
+    # the open transaction via the low-level path
+    txn._client.add_partitions_to_txn("rc-open", txn._pid, txn._epoch,
+                                      [("rco", 0)])
+    txn._client.produce("rco", 0, [(None, b"inflight")], acks=-1,
+                        message_format="v2",
+                        producer=(txn._pid, txn._epoch, 0),
+                        transactional_id="rc-open")
+    txn._pending.clear()
+
+    assert b.fetch("rco", 0, 0) == []  # open txn: invisible
+    txn._open = True
+    txn.commit()
+    vals = [r.value for r in b.fetch("rco", 0, 0)]
+    assert vals == [b"inflight"]
+    b.close()
+
+
+def test_read_committed_fencing_aborts_dangling_txn(stub):
+    """A crashed producer's dangling transaction (records at the broker,
+    EndTxn never sent) is epoch-fenced by the restarted task; the fencing
+    abort must make those records invisible to read_committed consumers —
+    the consume-side half of the crash test, under real-broker log
+    semantics."""
+    # per-test stub instance: no cross-test leak to undo
+    stub.log_transactional = True
+    b = KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2")
+    pid, epoch = b.client.init_producer_id(transactional_id="rc-crash")
+    b.client.add_partitions_to_txn("rc-crash", pid, epoch, [("rcc", 0)])
+    b.client.produce("rcc", 0, [(None, b"GHOST")], acks=-1,
+                     message_format="v2", producer=(pid, epoch, 0),
+                     transactional_id="rc-crash")
+    # crash: no EndTxn. Restarted task re-inits the same id -> fence.
+    txn2 = b.txn("rc-crash")
+    txn2.begin()
+    txn2.produce("rcc", b"real", partition=0)
+    txn2.commit()
+
+    rc = b.client.fetch("rcc", 0, 0, isolation="read_committed")
+    assert [r.value for r in rc] == [b"real"]
+    # the ghost IS in the raw log (real-broker semantics)...
+    raw = [r.value for r in b.client.fetch("rcc", 0, 0)]
+    assert b"GHOST" in raw
+    b.close()
+
+
+def test_read_committed_fetch_past_abort_marker(stub):
+    """Fetching from an offset PAST an abort marker must not re-activate
+    the stale aborted range and drop the same producer's later COMMITTED
+    records (regression: the stub reported every historical range, so the
+    ABORT marker — outside the fetched region — never deactivated the
+    producer and committed data vanished)."""
+    stub.log_transactional = True
+    b = KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2")
+    pid, epoch = b.client.init_producer_id(transactional_id="rc-mid")
+    # txn 1: aborted -> GHOST@0, ABORT marker@1
+    b.client.add_partitions_to_txn("rc-mid", pid, epoch, [("rcm", 0)])
+    b.client.produce("rcm", 0, [(None, b"GHOST")], acks=-1,
+                     message_format="v2", producer=(pid, epoch, 0),
+                     transactional_id="rc-mid")
+    b.client.end_txn("rc-mid", pid, epoch, commit=False)
+    # txn 2, SAME producer: committed -> real@2, COMMIT marker@3
+    b.client.add_partitions_to_txn("rc-mid", pid, epoch, [("rcm", 0)])
+    b.client.produce("rcm", 0, [(None, b"real")], acks=-1,
+                     message_format="v2", producer=(pid, epoch, 1),
+                     transactional_id="rc-mid")
+    b.client.end_txn("rc-mid", pid, epoch, commit=True)
+
+    # from 0: ghost filtered, real kept
+    rc0 = b.client.fetch("rcm", 0, 0, isolation="read_committed")
+    assert [r.value for r in rc0] == [b"real"]
+    # from 2 (past the abort marker): the committed record must survive
+    rc2 = b.client.fetch("rcm", 0, 2, isolation="read_committed")
+    assert [r.value for r in rc2] == [b"real"], [r.value for r in rc2]
+    b.close()
