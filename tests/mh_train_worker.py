@@ -1,0 +1,44 @@
+"""Worker half of the MULTI-PROCESS (simulated multi-host) training test:
+two OS processes, 4 CPU devices each, one global (4 x 2) mesh — the
+framework's dp x tp train step runs with XLA collectives crossing the
+process boundary (Gloo here; ICI/DCN on real slices). Run by
+tests/test_dist.py::test_multiprocess_train_step via subprocess."""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nproc, process_id=pid)
+
+import numpy as np  # noqa: E402
+
+from storm_tpu.models import build_model  # noqa: E402
+from storm_tpu.parallel.mesh import make_mesh  # noqa: E402
+from storm_tpu.parallel.train import (init_sharded_training,  # noqa: E402
+                                      train_one_step)
+
+devs = jax.devices()
+assert len(devs) == nproc * 4, devs  # global view spans both processes
+assert len(jax.local_devices()) == 4
+mesh = make_mesh(4, 2, devices=devs)
+
+model = build_model("vit_tiny", num_classes=10, input_shape=(32, 32, 3))
+train_step, params, opt_state, state = init_sharded_training(model, mesh,
+                                                             seed=0)
+rng = np.random.RandomState(0)  # same data on both hosts (SPMD contract)
+x = rng.rand(8, 32, 32, 3).astype(np.float32)
+y = rng.randint(0, 10, size=(8,))
+params, opt_state, state, loss = train_one_step(
+    train_step, mesh, params, opt_state, state, x, y)
+loss1 = float(loss)
+_, _, _, loss2 = train_one_step(train_step, mesh, params, opt_state, state,
+                                x, y)
+assert np.isfinite(loss1) and np.isfinite(float(loss2))
+assert float(loss2) < loss1  # the update crossed processes and helped
+print(f"MH-OK proc={pid} loss={loss1:.4f}->{float(loss2):.4f}", flush=True)
+jax.distributed.shutdown()
