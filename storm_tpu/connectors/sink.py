@@ -242,6 +242,19 @@ class TransactionalBrokerSink(BrokerSink):
     one commits. Cross-partition parallelism and spout chunking
     (``topology.spout_chunk``) carry the throughput.
 
+    Fan-out: when one spout entry's tree yields MULTIPLE sink tuples
+    (splitter bolt, chunked entries transformed per record), the tree's
+    outputs and its offsets must land in ONE transaction — otherwise a
+    crash between the tree's transactions either loses the uncommitted
+    siblings (offset already advanced) or duplicates the committed ones
+    (abort + full-tree replay). Origin-carrying tuples therefore PARK in
+    the sink until the ack ledger's live-edge refcount shows every
+    remaining edge of their tree is in the sink's buffer; only then does
+    the whole tree (plus its offsets) commit. Trees that fail or time out
+    drop their parked tuples (a ledger watch) and replay cleanly. This is
+    why ``offsets_group`` requires sink parallelism 1 (enforced at
+    ``prepare``): a tree split across sink executors could never close.
+
     Beyond the reference: its KafkaBolt acks on per-record delivery
     confirmation at best (KafkaBolt.java:129-155); duplicates on replay
     are unavoidable there."""
@@ -261,6 +274,18 @@ class TransactionalBrokerSink(BrokerSink):
             raise TypeError(
                 "sink.offsets_group needs a transaction handle with "
                 "send_offsets (KafkaTxn / MemoryTxn)")
+        if self._offsets_group and context.parallelism > 1:
+            # A fan-out tree split across sink executors can close in
+            # neither (each holds part of the tree, so each sees live
+            # edges elsewhere) — parked tuples would sit until tree
+            # timeout, replaying forever. EOS egress is single-writer per
+            # group, the same per-task model Kafka Streams uses.
+            raise ValueError(
+                "sink.offsets_group requires the transactional sink to "
+                f"run with parallelism 1 (got {context.parallelism}): "
+                "a tuple tree split across sink executors can never "
+                "close in either. Scale EOS throughput with spout "
+                "chunking and cross-partition parallelism instead.")
         self._blocking = bool(getattr(self.broker, "blocking", False))
         self._buf: list = []
         self._flush_lock = asyncio.Lock()
@@ -269,6 +294,21 @@ class TransactionalBrokerSink(BrokerSink):
             context.component_id, "txn_commits")
         self._m_aborts = context.metrics.counter(
             context.component_id, "txn_aborts")
+        self._m_deferred = context.metrics.counter(
+            context.component_id, "txn_offsets_deferred")
+        # Fan-out safety (offsets_group only, ADVICE r3-high): a spout
+        # entry's outputs and offsets must commit in ONE transaction, or a
+        # crash mid-tree either loses outputs (offset already committed
+        # past them) or duplicates them (abort + replay re-produces
+        # already-committed siblings). Tuples whose tree still has live
+        # edges outside the sink's hands are PARKED until the ledger's
+        # live-edge refcount says the whole tree is held, then the full
+        # tree + its offsets commit together. self._parked holds those
+        # (t, topic, key, value) items; self._watched tracks ledger
+        # watches that clean up parked tuples of failed trees.
+        self._parked: list = []
+        self._watched: set = set()
+        self._warned_unknown_tree = False
 
     async def execute(self, t: Tuple) -> None:
         try:
@@ -285,9 +325,8 @@ class TransactionalBrokerSink(BrokerSink):
         self._buf.append((t, topic, key, value))
         if len(self._buf) >= self.txn_batch:
             await self._flush_txn()
-        elif self._deadline_task is None or self._deadline_task.done():
-            self._deadline_task = asyncio.get_running_loop().create_task(
-                self._deadline_flush())
+        else:
+            self._rearm_deadline()
 
     async def _deadline_flush(self) -> None:
         await asyncio.sleep(self.txn_ms / 1e3)
@@ -296,25 +335,145 @@ class TransactionalBrokerSink(BrokerSink):
     async def flush(self) -> None:  # drain hook
         await self._flush_txn()
 
+    def _on_tree_done(self, root: int, ok: bool) -> None:
+        """Ledger watch callback for a parked root (fires on the loop).
+
+        ok=False (tree failed/timed out): drop the root's parked tuples —
+        the spout replays the whole entry, so producing stale outputs now
+        would duplicate — and fail() each dropped tuple so a JOIN tuple's
+        other, still-open trees settle immediately instead of waiting out
+        the message timeout. ok=True can only fire for edge cases where
+        the sink no longer holds the tree's tuples; nothing to do beyond
+        the bookkeeping either way — the deadline poll re-plans the rest.
+        """
+        self._watched.discard(root)
+        if not ok:
+            kept = []
+            for item in self._parked:
+                if root in item[0].anchors:
+                    self.collector.fail(item[0])
+                else:
+                    kept.append(item)
+            self._parked = kept
+
+    def _plan(self, held: list):
+        """Split held tuples into (flush_now, park) and fold the offsets
+        of flushing trees — synchronously on the loop BEFORE the produce
+        (which may run in a thread), so ledger reads can't race it.
+
+        A tree is flushable only when EVERY live edge the ledger tracks
+        for it is in our hands: then its whole output set + its source
+        offsets commit in one transaction (the KIP-98 EOS contract). A
+        multi-root tuple (join) parks if ANY of its trees is still open,
+        which re-opens its other trees — iterated to a fixpoint so no
+        flushed tree ever leaves a sibling output behind.
+        """
+        ledger = getattr(self.collector, "ledger", None)
+        by_root: dict = {}
+        for t, *_ in held:
+            for r in t.anchors:
+                by_root[r] = by_root.get(r, 0) + 1
+
+        open_roots: set = set()
+        dead_roots: set = set()
+        remote = False
+        if ledger is not None:
+            for r in by_root:
+                c = ledger.outstanding(r)
+                if c is None:
+                    remote = True  # remote-rooted tree: shape unknowable
+                elif c > by_root[r]:
+                    open_roots.add(r)
+                elif c < by_root[r]:
+                    # We hold by_root[r] unacked live edges of r; a live
+                    # ledger entry must count at least those. Fewer (0)
+                    # means the entry is GONE — and since completion needs
+                    # our edges acked, gone == failed/timed out. Flushing
+                    # these tuples would produce stale outputs (the spout
+                    # is replaying the entry) and could commit an offset
+                    # past a sibling that never ran: drop them instead.
+                    dead_roots.add(r)
+            # Dropping a joint (multi-root) tuple fails its OTHER trees
+            # too (the fail() below settles them) — those trees' tuples
+            # must drop in THIS pass, not flush ahead of the replay.
+            changed = True
+            while changed:
+                changed = False
+                for t, *_ in held:
+                    if (t.anchors
+                            and not t.anchors.isdisjoint(dead_roots)
+                            and not t.anchors <= dead_roots):
+                        dead_roots |= t.anchors
+                        changed = True
+            open_roots -= dead_roots
+            # Parking a joint tuple strands its other trees' outputs:
+            # treat those trees as open too, until nothing changes.
+            changed = True
+            while changed:
+                changed = False
+                for t, *_ in held:
+                    if (t.origins and t.anchors
+                            and t.anchors.isdisjoint(dead_roots)
+                            and not t.anchors.isdisjoint(open_roots)
+                            and not t.anchors <= open_roots):
+                        open_roots |= t.anchors
+                        changed = True
+        if remote and not self._warned_unknown_tree:
+            self._warned_unknown_tree = True
+            log.warning(
+                "EOS sink holds tuples of a tree rooted on a remote "
+                "worker: tree shape is unknowable locally, so offsets "
+                "commit with the first batch that carries them. Safe only "
+                "for 1:1 entry->sink-tuple topologies; co-locate the txn "
+                "sink with the spout for fan-out trees.")
+
+        now, park, offs = [], [], {}
+        for item in held:
+            t = item[0]
+            if t.anchors and not t.anchors.isdisjoint(dead_roots):
+                # Stale output of a failed/timed-out tree: the spout is
+                # replaying the whole entry. fail() settles a join
+                # tuple's other trees now (no-op for the dead root).
+                self.collector.fail(t)
+                continue
+            if (ledger is None or not t.origins or not t.anchors
+                    or t.anchors.isdisjoint(open_roots)):
+                now.append(item)
+                if t.origins:
+                    merge_offsets(offs, (((src_t, src_p), off)
+                                         for (src_t, src_p, off)
+                                         in t.origins))
+            else:
+                park.append(item)
+                self._m_deferred.inc()
+                for r in t.anchors:
+                    if r not in self._watched and ledger.watch(
+                            r, (lambda ok, _r=r:
+                                self._on_tree_done(_r, ok))):
+                        self._watched.add(r)
+        return now, park, offs
+
     async def _flush_txn(self) -> None:
         async with self._flush_lock:
-            batch, self._buf = self._buf, []
-            if not batch:
+            held = self._parked + self._buf
+            self._buf = []
+            self._parked = []
+            if not held:
                 return
+            if self._offsets_group:
+                batch, self._parked, offs = self._plan(held)
+                if not batch:
+                    self._rearm_deadline()  # poll until the trees close
+                    return
+            else:
+                batch, offs = held, {}
 
             def run() -> None:
                 self._txn.begin()
-                # Fold each tuple's source provenance into {(topic,
-                # partition): next_offset} (max wins: origins carry
-                # last-consumed + 1) and commit it INSIDE the transaction —
-                # offsets never land without the records.
-                offs: dict = {}
                 for t, topic, key, value in batch:
                     self._txn.produce(topic, value, key)
-                    if self._offsets_group:
-                        merge_offsets(
-                            offs, (((src_t, src_p), off)
-                                   for (src_t, src_p, off) in t.origins))
+                # Offsets (planned above) commit INSIDE the transaction —
+                # they never land without the records.
                 if offs:
                     self._txn.send_offsets(self._offsets_group, offs)
                 self._txn.commit()
@@ -341,21 +500,27 @@ class TransactionalBrokerSink(BrokerSink):
                 for t, *_ in batch:
                     self._ack_delivered(t)
             # Re-arm the deadline for tuples that arrived while this flush
-            # held the lock — on BOTH the commit and the failed/abort path
-            # (a failed flush leaves mid-flush arrivals just as stranded) —
-            # without it they could sit unflushed until another tuple shows
-            # up (and then double-commit after replay).
-            # NB: when THIS flush was triggered by the deadline task, that
-            # task is still `running` (it is us), so `.done()` is False —
-            # treat the currently-executing task as done or the re-arm is
-            # skipped and the buffered tuples sit unacked until tree
-            # timeout + replay (the double-commit this branch prevents).
-            stale = (self._deadline_task is None
-                     or self._deadline_task.done()
-                     or self._deadline_task is asyncio.current_task())
-            if self._buf and stale:
-                self._deadline_task = asyncio.get_running_loop().create_task(
-                    self._deadline_flush())
+            # held the lock, AND for parked tuples (their trees close when
+            # upstream acks land, so the poll is what re-plans them) — on
+            # BOTH the commit and the failed/abort path (a failed flush
+            # leaves mid-flush arrivals just as stranded) — without it
+            # they could sit unflushed until another tuple shows up (and
+            # then double-commit after replay).
+            if self._buf or self._parked:
+                self._rearm_deadline()
+
+    def _rearm_deadline(self) -> None:
+        # NB: when the current flush was triggered by the deadline task,
+        # that task is still `running` (it is us), so `.done()` is False —
+        # treat the currently-executing task as done or the re-arm is
+        # skipped and the buffered tuples sit unacked until tree timeout +
+        # replay (the double-commit this re-arm prevents).
+        stale = (self._deadline_task is None
+                 or self._deadline_task.done()
+                 or self._deadline_task is asyncio.current_task())
+        if stale:
+            self._deadline_task = asyncio.get_running_loop().create_task(
+                self._deadline_flush())
 
     def cleanup(self) -> None:
         if self._deadline_task is not None:

@@ -192,6 +192,37 @@ class DistLedger:
         else:
             self._senders[owner].put_ack_nowait("xor", root_id, edge_id)
 
+    def anchor(self, root_id: int, edge_id: int) -> None:
+        owner = owner_of(root_id)
+        if owner == self._idx or owner not in self._senders:
+            self._base.anchor(root_id, edge_id)
+        else:
+            self._senders[owner].put_ack_nowait("anc", root_id, edge_id)
+
+    def ack_edge(self, root_id: int, edge_id: int) -> None:
+        owner = owner_of(root_id)
+        if owner == self._idx or owner not in self._senders:
+            self._base.ack_edge(root_id, edge_id)
+        else:
+            self._senders[owner].put_ack_nowait("ake", root_id, edge_id)
+
+    def outstanding(self, root_id: int):
+        """Live-edge count — only answerable for roots this worker owns.
+
+        Returns None for remote roots: the EOS sink treats None as
+        "unknown tree shape" and falls back to immediate offset folding
+        (safe only for 1:1 entry→sink-tuple trees; see
+        TransactionalBrokerSink docs).
+        """
+        if owner_of(root_id) == self._idx:
+            return self._base.outstanding(root_id)
+        return None
+
+    def watch(self, root_id: int, cb) -> bool:
+        if owner_of(root_id) == self._idx:
+            return self._base.watch(root_id, cb)
+        return False
+
     def fail_root(self, root_id: int) -> None:
         owner = owner_of(root_id)
         if owner == self._idx or owner not in self._senders:
@@ -359,7 +390,11 @@ class DistRuntime(TopologyRuntime):
 
         def apply():
             for op, root, edge in ops:
-                if op == "xor":
+                if op == "anc":
+                    self.ledger.anchor(root, edge)
+                elif op == "ake":
+                    self.ledger.ack_edge(root, edge)
+                elif op == "xor":  # pre-refcount peers (upgrade all-at-once)
                     self.ledger.xor(root, edge)
                 else:
                     self.ledger.fail_root(root)

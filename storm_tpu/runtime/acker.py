@@ -19,8 +19,8 @@ dict is enough for one host and keeps this O(1) per event with no tasks).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
 
 
 @dataclass
@@ -30,6 +30,12 @@ class _Entry:
     on_done: Callable[[Any, bool, float], None]  # (msg_id, ok, root_ts)
     born: float
     root_ts: float
+    # Exact live-edge refcount (kept alongside the XOR so the EOS sink can
+    # ask "is this batch the tree's last outstanding work?" — see
+    # ``outstanding``). Only maintained by anchor/ack_edge; the legacy
+    # ``xor`` entry point can't tell an emit from an ack and leaves it.
+    live: int = 0
+    watchers: List[Callable[[bool], None]] = field(default_factory=list)
 
 
 class AckLedger:
@@ -72,6 +78,42 @@ class AckLedger:
             del self._entries[root_id]
             self.acked += 1
             e.on_done(e.msg_id, True, e.root_ts)
+            for w in e.watchers:
+                w(True)
+
+    def anchor(self, root_id: int, edge_id: int) -> None:
+        """A new live edge was delivered under this root (emit event)."""
+        e = self._entries.get(root_id)
+        if e is not None:
+            e.live += 1
+        self.xor(root_id, edge_id)
+
+    def ack_edge(self, root_id: int, edge_id: int) -> None:
+        """A live edge was consumed (ack event)."""
+        e = self._entries.get(root_id)
+        if e is not None:
+            e.live -= 1
+        self.xor(root_id, edge_id)
+
+    def outstanding(self, root_id: int) -> int:
+        """Exact count of live (delivered, unacked) edges for this root.
+
+        0 means the tree is complete (or never existed / already failed).
+        Valid only if every edge event went through anchor/ack_edge.
+        """
+        e = self._entries.get(root_id)
+        return e.live if e is not None else 0
+
+    def watch(self, root_id: int, cb: Callable[[bool], None]) -> bool:
+        """Register ``cb(ok)`` to fire when the root completes, fails, or
+        times out. Returns False (cb NOT registered) if the root is already
+        gone — the caller saw a stale id and must decide for itself.
+        """
+        e = self._entries.get(root_id)
+        if e is None:
+            return False
+        e.watchers.append(cb)
+        return True
 
     def fail_root(self, root_id: int) -> None:
         e = self._entries.pop(root_id, None)
@@ -79,6 +121,8 @@ class AckLedger:
             return
         self.failed += 1
         e.on_done(e.msg_id, False, e.root_ts)
+        for w in e.watchers:
+            w(False)
 
     def sweep(self) -> int:
         """Fail entries older than the message timeout. Returns count failed.
@@ -96,4 +140,6 @@ class AckLedger:
                 self.timed_out += 1
                 self.failed += 1
                 e.on_done(e.msg_id, False, e.root_ts)
+                for w in e.watchers:
+                    w(False)
         return len(stale)

@@ -158,7 +158,7 @@ class OutputCollector:
         edges = [new_id() for _ in deliveries]
         for edge in edges:
             for r in roots:
-                self._rt.ledger.xor(r, edge)
+                self._rt.ledger.anchor(r, edge)
         n = 0
         for inbox, edge in zip(deliveries, edges):
             t = Tuple(
@@ -202,7 +202,7 @@ class OutputCollector:
     def ack(self, t: Tuple) -> None:
         """Mark the input tuple consumed (InferenceBolt.java:99)."""
         for r in t.anchors:
-            self._rt.ledger.xor(r, t.edge_id)
+            self._rt.ledger.ack_edge(r, t.edge_id)
         self._m_acked.inc()
 
     def fail(self, t: Tuple) -> None:
@@ -213,6 +213,13 @@ class OutputCollector:
 
     def report_error(self, err: BaseException) -> None:
         self._rt.report_error(self.component_id, self.task_index, err)
+
+    @property
+    def ledger(self):
+        """The runtime's ack ledger (AckLedger in-process, RoutedLedger in
+        dist workers). Exposed for the EOS sink's tree-shape queries
+        (outstanding/watch); normal bolts never need it."""
+        return self._rt.ledger
 
 
 class Component:

@@ -662,3 +662,233 @@ def test_append_root_ts_clamps_future_timestamps():
         before = _time.perf_counter()
         ts = spout._append_root_ts(rec)
         assert before <= ts <= _time.perf_counter()  # age ~0
+
+
+# ---- EOS fan-out: whole tree per transaction (ADVICE r3-high) ----------------
+
+
+def test_eos_fanout_whole_tree_single_txn(run):
+    """One spout entry fanning out to multiple sink tuples must commit ALL
+    its outputs + its source offsets in ONE transaction even when txn_batch
+    would split the tree (ADVICE r3-high, sink.py fold-on-first-sight).
+    A recording txn asserts, at every commit, that a committed source
+    offset is fully covered by its tree's outputs already in the topic —
+    never an offset ahead of unproduced siblings."""
+    from storm_tpu.connectors import TransactionalBrokerSink
+    from storm_tpu.runtime import Bolt, Values
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    G = "eos-fan"
+    FAN = 3
+    violations = []
+
+    class RecTxn:
+        def __init__(self, inner, broker):
+            self._inner, self._broker = inner, broker
+
+        def begin(self):
+            self._inner.begin()
+
+        def produce(self, *a, **kw):
+            self._inner.produce(*a, **kw)
+
+        def send_offsets(self, *a, **kw):
+            self._inner.send_offsets(*a, **kw)
+
+        def abort(self):
+            self._inner.abort()
+
+        def commit(self):
+            self._inner.commit()
+            out_vals = {r.value.decode()
+                        for r in self._broker.drain_topic("out")}
+            for p in range(2):
+                k = self._broker.committed(G, "in", p)
+                if k is None:
+                    continue
+                for rec in self._broker.fetch("in", p, 0, 100)[:k]:
+                    v = rec.value.decode()
+                    missing = [j for j in range(FAN)
+                               if f"{v}/{j}" not in out_vals]
+                    if missing:
+                        violations.append((v, missing))
+
+    class RecBroker(MemoryBroker):
+        def txn(self, txn_id):
+            return RecTxn(super().txn(txn_id), self)
+
+    class SplitBolt(Bolt):
+        async def execute(self, t):
+            for j in range(FAN):
+                await self.collector.emit(
+                    Values([f'{t.get("message")}/{j}']), anchors=[t])
+            self.collector.ack(t)
+
+    async def main():
+        broker = RecBroker(default_partitions=2)
+        for i in range(8):
+            broker.produce("in", f"r{i}", partition=i % 2)
+        tb = TopologyBuilder()
+        tb.set_spout("s", BrokerSpout(
+            broker, "in",
+            OffsetsConfig(policy="txn", group_id=G, max_behind=None)), 1)
+        tb.set_bolt("mid", SplitBolt(), 1).shuffle_grouping("s")
+        # txn_batch=2 < FAN: fold-on-first-sight would commit the entry's
+        # offset in a transaction holding only part of its tree.
+        tb.set_bolt("sink", TransactionalBrokerSink(
+            broker, "out",
+            SinkConfig(mode="transactional", txn_batch=2, txn_ms=20.0,
+                       offsets_group=G)), 1).shuffle_grouping("mid")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("fan", Config(), tb.build())
+        deadline = asyncio.get_event_loop().time() + 25
+        while asyncio.get_event_loop().time() < deadline:
+            if (broker.topic_size("out") >= 8 * FAN
+                    and all(broker.committed(G, "in", p) == 4
+                            for p in range(2))):
+                break
+            await asyncio.sleep(0.05)
+        snap = rt.metrics.snapshot()
+        await cluster.shutdown()
+        assert violations == [], violations
+        vals = sorted(r.value.decode() for r in broker.drain_topic("out"))
+        assert vals == sorted(
+            f"r{i}/{j}" for i in range(8) for j in range(FAN)), vals
+        committed = {p: broker.committed(G, "in", p) for p in range(2)}
+        assert committed == {0: 4, 1: 4}, committed
+        # parking actually engaged (the batch boundary DID split the tree)
+        assert snap["sink"]["txn_offsets_deferred"] > 0, snap["sink"]
+
+    run(main(), timeout=60)
+
+
+def test_eos_offsets_group_rejects_parallel_sink(run):
+    """offsets_group + sink parallelism > 1 must fail loudly at prepare: a
+    fan-out tree split across sink executors can close in neither (each
+    sees live edges held by the other), so parked tuples would replay
+    forever."""
+    from storm_tpu.connectors import TransactionalBrokerSink
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    async def main():
+        broker = MemoryBroker(default_partitions=2)
+        tb = TopologyBuilder()
+        tb.set_spout("s", BrokerSpout(
+            broker, "in",
+            OffsetsConfig(policy="txn", group_id="g", max_behind=None)), 1)
+        tb.set_bolt("sink", TransactionalBrokerSink(
+            broker, "out",
+            SinkConfig(mode="transactional", offsets_group="g")),
+            2).shuffle_grouping("s")
+        cluster = AsyncLocalCluster()
+        with pytest.raises(ValueError, match="parallelism 1"):
+            await cluster.submit("fan2", Config(), tb.build())
+        await cluster.shutdown()
+
+    run(main(), timeout=30)
+
+
+def test_eos_fanout_sibling_failure_no_partial_commit(run):
+    """When one sibling of a fan-out tree fails mid-flight, the sink's
+    parked siblings belong to a FAILED tree (ledger entry gone): they must
+    be dropped, never produced or offset-committed — the replayed tree
+    then commits whole. Guards the outstanding()==0 'gone means failed,
+    not closed' distinction in _plan."""
+    from storm_tpu.connectors import TransactionalBrokerSink
+    from storm_tpu.runtime import Bolt, Values
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    G = "eos-fail"
+    FAN = 3
+    violations = []
+
+    class RecTxn:
+        def __init__(self, inner, broker):
+            self._inner, self._broker = inner, broker
+
+        def begin(self):
+            self._inner.begin()
+
+        def produce(self, *a, **kw):
+            self._inner.produce(*a, **kw)
+
+        def send_offsets(self, *a, **kw):
+            self._inner.send_offsets(*a, **kw)
+
+        def abort(self):
+            self._inner.abort()
+
+        def commit(self):
+            self._inner.commit()
+            out_vals = [r.value.decode()
+                        for r in self._broker.drain_topic("out")]
+            if len(out_vals) != len(set(out_vals)):
+                violations.append(("dupes", sorted(out_vals)))
+            uniq = set(out_vals)
+            for p in range(2):
+                k = self._broker.committed(G, "in", p)
+                if k is None:
+                    continue
+                for rec in self._broker.fetch("in", p, 0, 100)[:k]:
+                    v = rec.value.decode()
+                    missing = [j for j in range(FAN)
+                               if f"{v}/{j}" not in uniq]
+                    if missing:
+                        violations.append((v, missing))
+
+    class RecBroker(MemoryBroker):
+        def txn(self, txn_id):
+            return RecTxn(super().txn(txn_id), self)
+
+    class SplitBolt(Bolt):
+        async def execute(self, t):
+            for j in range(FAN):
+                await self.collector.emit(
+                    Values([f'{t.get("message")}/{j}']), anchors=[t])
+            self.collector.ack(t)
+
+    class FlakyPass(Bolt):
+        failed = False
+
+        async def execute(self, t):
+            v = t.get("message")
+            if v.endswith("/1") and not FlakyPass.failed:
+                FlakyPass.failed = True
+                self.collector.fail(t)  # kills the whole tree
+                return
+            await self.collector.emit(Values([v]), anchors=[t])
+            self.collector.ack(t)
+
+    async def main():
+        FlakyPass.failed = False
+        broker = RecBroker(default_partitions=2)
+        for i in range(4):
+            broker.produce("in", f"r{i}", partition=i % 2)
+        tb = TopologyBuilder()
+        tb.set_spout("s", BrokerSpout(
+            broker, "in",
+            OffsetsConfig(policy="txn", group_id=G, max_behind=None)), 1)
+        tb.set_bolt("split", SplitBolt(), 1).shuffle_grouping("s")
+        tb.set_bolt("mid", FlakyPass(), 1).shuffle_grouping("split")
+        tb.set_bolt("sink", TransactionalBrokerSink(
+            broker, "out",
+            SinkConfig(mode="transactional", txn_batch=2, txn_ms=20.0,
+                       offsets_group=G)), 1).shuffle_grouping("mid")
+        cluster = AsyncLocalCluster()
+        await cluster.submit("fanfail", Config(), tb.build())
+        deadline = asyncio.get_event_loop().time() + 25
+        while asyncio.get_event_loop().time() < deadline:
+            if (broker.topic_size("out") >= 4 * FAN
+                    and all(broker.committed(G, "in", p) == 2
+                            for p in range(2))):
+                break
+            await asyncio.sleep(0.05)
+        await cluster.shutdown()
+        assert violations == [], violations
+        vals = sorted(r.value.decode() for r in broker.drain_topic("out"))
+        assert vals == sorted(
+            f"r{i}/{j}" for i in range(4) for j in range(FAN)), vals
+        committed = {p: broker.committed(G, "in", p) for p in range(2)}
+        assert committed == {0: 2, 1: 2}, committed
+
+    run(main(), timeout=60)

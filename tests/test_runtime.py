@@ -471,3 +471,37 @@ def test_stable_hash_groupings_cross_process_consistent():
         for seed in (1, 2)
     }
     assert len(outs) == 1 and outs != {""}, outs
+
+
+def test_ledger_live_edge_refcount_and_watch():
+    """anchor/ack_edge maintain an exact outstanding-edge count alongside
+    the XOR, and watch() fires on completion/failure — the queries the EOS
+    sink's whole-tree-per-txn parking needs (ADVICE r3-high)."""
+    led = AckLedger(timeout_s=0)
+    root = new_id()
+    led.init_root(root, "m", lambda *a: None, 0.0)
+    e1, e2, e3 = new_id(), new_id(), new_id()
+    led.anchor(root, e1)
+    led.anchor(root, e2)
+    assert led.outstanding(root) == 2
+    led.ack_edge(root, e1)
+    assert led.outstanding(root) == 1
+    led.anchor(root, e3)
+    assert led.outstanding(root) == 2
+    fates = []
+    assert led.watch(root, fates.append)
+    led.ack_edge(root, e2)
+    led.ack_edge(root, e3)
+    assert led.outstanding(root) == 0  # gone == complete
+    assert fates == [True]
+    assert not led.watch(root, fates.append)  # entry gone -> not registered
+
+    # failure path: watchers hear ok=False, count resets to 0
+    r2 = new_id()
+    led.init_root(r2, "m2", lambda *a: None, 0.0)
+    led.anchor(r2, new_id())
+    fates2 = []
+    led.watch(r2, fates2.append)
+    led.fail_root(r2)
+    assert fates2 == [False]
+    assert led.outstanding(r2) == 0
