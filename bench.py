@@ -627,7 +627,11 @@ def run_autoscale(args) -> dict:
 
 def _run_autoscale_inner(args, cfg, cluster, broker, payloads, n_dev,
                          run_cfg, topo) -> dict:
-    from storm_tpu.runtime.autoscale import AutoscalePolicy, Autoscaler
+    from storm_tpu.runtime.autoscale import (
+        ACCEL_MAX_PARALLELISM,
+        AutoscalePolicy,
+        Autoscaler,
+    )
 
     t0 = time.time()
     cluster.submit_topology("bench-slo", run_cfg, topo)
@@ -647,7 +651,8 @@ def _run_autoscale_inner(args, cfg, cluster, broker, payloads, n_dev,
                 # (8 tasks measured ~15% SLOWER than 1 in this
                 # environment — each bolt's deadline flushes tiny
                 # batches). Cap where pipelining still wins.
-                min_parallelism=1, max_parallelism=3,
+                min_parallelism=1,
+                max_parallelism=ACCEL_MAX_PARALLELISM,
                 interval_s=2.0, cooldown=6,
             )).start()
 

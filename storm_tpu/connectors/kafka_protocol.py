@@ -714,12 +714,19 @@ class KafkaWireClient:
         try:
             r = conn.request(18, 0, bytes(w.buf))
             err = r.i16()
-            if err:
+            # Per the protocol an UNSUPPORTED_VERSION (35) reply still
+            # carries the supported-versions array — a future broker
+            # answering v0 with error 35 is exactly the case the loud
+            # KIP-896 check exists for, so parse and validate rather
+            # than treating it as a silent no-answer (ADVICE r3-low).
+            if err and err != 35:
                 return None
             out: Dict[int, Tuple[int, int]] = {}
             for _ in range(r.i32()):
                 key = r.i16()
                 out[key] = (r.i16(), r.i16())
+            if err and not out:
+                return None  # errored AND empty array: nothing to learn
             return out
         except (OSError, KafkaProtocolError):
             return None  # no/garbled answer: era-compatible broker assumed

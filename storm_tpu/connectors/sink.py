@@ -348,15 +348,18 @@ class TransactionalBrokerSink(BrokerSink):
         """
         self._watched.discard(root)
         if not ok:
-            kept = []
-            for item in self._parked:
-                if root in item[0].anchors:
-                    self.collector.fail(item[0])
-                else:
-                    kept.append(item)
-            self._parked = kept
+            # Reassign BEFORE failing: fail() can fire nested watchers
+            # (a join tuple's other roots) that re-enter this method, and
+            # they must see the already-pruned list — failing first would
+            # let the outer call clobber their pruning with a stale copy.
+            drop = [item for item in self._parked
+                    if root in item[0].anchors]
+            self._parked = [item for item in self._parked
+                            if root not in item[0].anchors]
+            for item in drop:
+                self.collector.fail(item[0])
 
-    def _plan(self, held: list):
+    def _plan(self, held: list, n_prev: int = 0):
         """Split held tuples into (flush_now, park) and fold the offsets
         of flushing trees — synchronously on the loop BEFORE the produce
         (which may run in a thread), so ledger reads can't race it.
@@ -428,7 +431,7 @@ class TransactionalBrokerSink(BrokerSink):
                 "sink with the spout for fan-out trees.")
 
         now, park, offs = [], [], {}
-        for item in held:
+        for idx, item in enumerate(held):
             t = item[0]
             if t.anchors and not t.anchors.isdisjoint(dead_roots):
                 # Stale output of a failed/timed-out tree: the spout is
@@ -445,7 +448,8 @@ class TransactionalBrokerSink(BrokerSink):
                                          in t.origins))
             else:
                 park.append(item)
-                self._m_deferred.inc()
+                if idx >= n_prev:  # count deferrals once, not per re-plan
+                    self._m_deferred.inc()
                 for r in t.anchors:
                     if r not in self._watched and ledger.watch(
                             r, (lambda ok, _r=r:
@@ -455,13 +459,14 @@ class TransactionalBrokerSink(BrokerSink):
 
     async def _flush_txn(self) -> None:
         async with self._flush_lock:
+            n_prev = len(self._parked)
             held = self._parked + self._buf
             self._buf = []
             self._parked = []
             if not held:
                 return
             if self._offsets_group:
-                batch, self._parked, offs = self._plan(held)
+                batch, self._parked, offs = self._plan(held, n_prev)
                 if not batch:
                     self._rearm_deadline()  # poll until the trees close
                     return

@@ -64,6 +64,14 @@ def decode_tuple(enc: list, now: float) -> Tuple:
     # 8-element envelope — degrade to empty origins (EOS disabled for that
     # sender's tuples) instead of erroring the whole Deliver RPC and
     # wedging every tree from it into timeout/replay.
+    #
+    # VERSIONING CONTRACT (ADVICE r3-low): from this version on, receivers
+    # ignore unknown TRAILING envelope elements (the enc[:8] + indexed-
+    # optional pattern below) and unknown ack-op names are dropped, so
+    # adding fields/ops stays rolling-restart safe FORWARD. The guarantee
+    # does not reach backward: pre-origins receivers hard-unpack 8
+    # elements and treat unknown ack ops as fail_root — upgrading ACROSS
+    # that boundary must be all-at-once (stop every worker, then restart).
     values, fields, stream, src, src_task, edge, anchors, age = enc[:8]
     origins = enc[8] if len(enc) > 8 else []
     return Tuple(

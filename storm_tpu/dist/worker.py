@@ -396,8 +396,14 @@ class DistRuntime(TopologyRuntime):
                     self.ledger.ack_edge(root, edge)
                 elif op == "xor":  # pre-refcount peers (upgrade all-at-once)
                     self.ledger.xor(root, edge)
-                else:
+                elif op == "fail":
                     self.ledger.fail_root(root)
+                else:
+                    # Unknown op from a NEWER peer: drop, don't guess —
+                    # part of the envelope versioning contract
+                    # (transport.decode_tuple). The tree times out and
+                    # replays rather than mis-acking.
+                    log.warning("unknown ack op %r dropped", op)
 
         # Ledger on_done callbacks touch spout executor state -> loop thread.
         loop.call_soon_threadsafe(apply)

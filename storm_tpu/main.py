@@ -164,10 +164,16 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
                                 interval_s=metrics_interval_s)
     scalers = []
     if autoscale_target_ms > 0:
-        from storm_tpu.runtime.autoscale import Autoscaler, AutoscalePolicy
+        from storm_tpu.runtime.autoscale import (
+            ACCEL_MAX_PARALLELISM,
+            Autoscaler,
+            AutoscalePolicy,
+        )
 
         # One autoscaler per inference/sink pair: the standard topology has
-        # one; a multi-model topology has one per pipeline.
+        # one; a multi-model topology has one per pipeline. The inference
+        # operator fronts a batching accelerator, so ITS policy carries the
+        # measured inversion cap (not the global dataclass default).
         pairs = (
             [(f"{p.name}-inference", f"{p.name}-sink") for p in cfg.pipelines]
             if cfg.pipelines
@@ -181,6 +187,7 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
                     latency_source=sink_id,
                     high_ms=autoscale_target_ms,
                     low_ms=autoscale_target_ms / 4,
+                    max_parallelism=ACCEL_MAX_PARALLELISM,
                 ),
             ).start()
             for infer_id, sink_id in pairs

@@ -78,7 +78,11 @@ def build_longseq(
         return L.dense(params["head"], h), state
 
     return ModelDef(name=name, init=init, apply=apply, apply_sp=apply_sp,
-                    input_shape=input_shape, num_classes=num_classes)
+                    input_shape=input_shape, num_classes=num_classes,
+                    hyper={"num_heads": num_heads, "dim": dim,
+                           "depth": depth, "mlp_dim": mlp_dim,
+                           "input_shape": input_shape,
+                           "num_classes": num_classes})
 
 
 @register("longseq_encoder")

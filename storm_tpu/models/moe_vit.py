@@ -81,7 +81,13 @@ def build_moe_vit(
             return logits, {**state, "moe_aux_loss": aux_total}
         return logits, state
 
-    return ModelDef(name, input_shape, num_classes, init, apply)
+    return ModelDef(name, input_shape, num_classes, init, apply,
+                    hyper={"num_heads": num_heads, "dim": dim,
+                           "depth": depth, "mlp_dim": mlp_dim,
+                           "patch": patch, "n_experts": n_experts,
+                           "capacity_factor": capacity_factor,
+                           "input_shape": input_shape,
+                           "num_classes": num_classes})
 
 
 @register("moe_vit_tiny")

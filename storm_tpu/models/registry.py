@@ -37,6 +37,12 @@ class ModelDef:
     # the S axis of ``x`` sharded over ``seq_axis`` (ring attention), never
     # materializing the full sequence on one chip. None = SP-unaware.
     apply_sp: Any = None
+    # Compute-relevant hyperparameters that are NOT recoverable from param
+    # shapes (num_heads above all: attention projections are dim x dim for
+    # ANY head count, so a checkpoint trained with 8 heads loads cleanly
+    # into a 2-head model and silently computes wrong outputs — ADVICE r3
+    # medium). Saved alongside checkpoints and validated at load.
+    hyper: Any = None
 
 
 _BUILDERS: Dict[str, Callable[..., ModelDef]] = {}
@@ -79,21 +85,91 @@ def init_params(model: ModelDef, seed: int = 0):
     return model.init(jax.random.PRNGKey(seed))
 
 
+_HYPER_SIDECAR = "storm_tpu_hyper.json"
+
+
+def _check_hyper(model: ModelDef, checkpoint: str) -> None:
+    """Refuse to load a checkpoint whose recorded hyperparameters disagree
+    with the model's. Param shapes can't catch these (e.g. num_heads:
+    projections are dim x dim for any head count) — a mismatch loads
+    cleanly and computes differently-partitioned attention with no error
+    (ADVICE r3 medium, models/longseq.py num_heads 8 -> 2)."""
+    import json
+    import os
+
+    sidecar = os.path.join(checkpoint, _HYPER_SIDECAR)
+    if model.hyper is None or not os.path.exists(sidecar):
+        return  # pre-sidecar checkpoint or hyper-less model: best effort
+    try:
+        with open(sidecar) as f:
+            saved = json.load(f)
+    except (OSError, ValueError) as e:
+        # A corrupt sidecar must not brick an otherwise-valid checkpoint —
+        # the check is an extra guard, not a load dependency.
+        import logging
+
+        logging.getLogger("storm_tpu.models").warning(
+            "unreadable hyper sidecar %s (%s); skipping the "
+            "hyperparameter compatibility check", sidecar, e)
+        return
+    mismatches = {
+        k: (saved[k], v) for k, v in model.hyper.items()
+        if k in saved and _canon(saved[k]) != _canon(v)}
+    if mismatches:
+        detail = ", ".join(
+            f"{k}: checkpoint={s!r} model={m!r}"
+            for k, (s, m) in sorted(mismatches.items()))
+        raise ValueError(
+            f"checkpoint {checkpoint!r} was saved with different "
+            f"hyperparameters than model {model.name!r} ({detail}). "
+            "Loading it would compute silently-wrong outputs even though "
+            "param shapes match; rebuild the model with the checkpoint's "
+            "hyperparameters (ModelConfig.extra) or retrain.")
+
+
+def _canon(v):
+    # JSON round-trips tuples as lists; compare structurally.
+    return list(v) if isinstance(v, tuple) else v
+
+
 def load_or_init(model: ModelDef, checkpoint: Optional[str], seed: int = 0):
     """Load params/state from an orbax checkpoint dir, or initialize."""
     params, state = init_params(model, seed)
     if checkpoint:
         import orbax.checkpoint as ocp
 
+        _check_hyper(model, checkpoint)
         with ocp.StandardCheckpointer() as ckptr:
             restored = ckptr.restore(checkpoint, {"params": params, "state": state})
         params, state = restored["params"], restored["state"]
     return params, state
 
 
-def save_checkpoint(path: str, params, state) -> None:
+def save_checkpoint(path: str, params, state,
+                    model: Optional[ModelDef] = None) -> None:
     import orbax.checkpoint as ocp
 
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, {"params": params, "state": state})
         ckptr.wait_until_finished()
+    if model is not None and model.hyper is not None:
+        import json
+        import os
+        import tempfile
+
+        # Atomic publish (mkstemp + fsync + replace, the state.py pattern):
+        # a crash mid-write must not leave a truncated sidecar that fails
+        # every subsequent load of a valid checkpoint.
+        fd, tmp = tempfile.mkstemp(dir=path, suffix=".hyper.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump({"model": model.name, **model.hyper}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(path, _HYPER_SIDECAR))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise

@@ -81,7 +81,11 @@ def build_vit(
         tok = L.layernorm(params["ln"], tok)
         return L.dense(params["head"], tok[:, 0]), state
 
-    return ModelDef(name, input_shape, num_classes, init, apply, flagship=True)
+    return ModelDef(name, input_shape, num_classes, init, apply, flagship=True,
+                    hyper={"num_heads": num_heads, "dim": dim, "depth": depth,
+                           "mlp_dim": mlp_dim, "patch": patch,
+                           "input_shape": input_shape,
+                           "num_classes": num_classes})
 
 
 @register("vit_b16")

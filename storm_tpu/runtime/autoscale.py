@@ -36,16 +36,24 @@ class AutoscalePolicy:
     high_ms: float = 200.0
     low_ms: float = 50.0
     min_parallelism: int = 1
-    # Default encodes the MEASURED inversion, not Storm intuition: in
-    # front of a batching accelerator, operator parallelism is pipelining
-    # depth — 8 bolts benched ~15% SLOWER than 1 (each task's deadline
-    # flushes fragmented micro-batches; BENCH_NOTES round 2). Past ~2-3
-    # tasks more parallelism HURTS, so the cap sits where pipelining still
-    # wins. Raise it only for non-batching (CPU-bound) bolts, where
-    # Storm's more-executors-more-throughput model actually applies.
-    max_parallelism: int = 3
+    # Storm-style default: more executors scale CPU-bound bolts, so the
+    # GLOBAL default keeps the generous cap (ADVICE r3-low: a round-3
+    # change to 3 here silently stopped CPU-bound topologies from scaling
+    # past 3). The measured accelerator inversion — in front of a batching
+    # accelerator, parallelism is pipelining depth and 8 bolts benched
+    # ~15% SLOWER than 1 (BENCH_NOTES round 2) — belongs to the INFERENCE
+    # operator's policy, applied where it is configured:
+    # ``ACCEL_MAX_PARALLELISM`` (main.py daemon, bench harness).
+    max_parallelism: int = 16
     interval_s: float = 5.0
     cooldown: int = 3  # consecutive calm checks before scaling down
+
+
+# Measured cap for bolts that front a batching accelerator: past ~2-3
+# tasks, deadline flushes fragment micro-batches and throughput inverts
+# (BENCH_NOTES round 2). Use for InferenceBolt autoscale policies; leave
+# the dataclass default for CPU-bound bolts.
+ACCEL_MAX_PARALLELISM = 3
 
 
 class Autoscaler:

@@ -195,15 +195,21 @@ class KafkaStubBroker:
     def _api_versions(self, r: Reader) -> bytes:
         if self.api_versions == "closed":
             raise OSError("simulated pre-0.10 broker: hang up on probe")
+        err = 0
         if self.api_versions is None:
             from storm_tpu.connectors.kafka_protocol import PINNED_API_VERSIONS
             ranges = {key: (min(vs), max(vs))
                       for key, (_n, vs) in PINNED_API_VERSIONS.items()}
             ranges[18] = (0, 0)
+        elif (isinstance(self.api_versions, tuple)
+              and self.api_versions[0] == "error35"):
+            # KIP-511-era behavior: the broker rejects the request version
+            # with UNSUPPORTED_VERSION but still advertises what it serves.
+            err, ranges = 35, self.api_versions[1]
         else:
             ranges = self.api_versions
         w = Writer()
-        w.i16(0)  # error
+        w.i16(err)
         w.i32(len(ranges))
         for key, (lo, hi) in sorted(ranges.items()):
             w.i16(key).i16(lo).i16(hi)

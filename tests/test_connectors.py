@@ -667,20 +667,13 @@ def test_append_root_ts_clamps_future_timestamps():
 # ---- EOS fan-out: whole tree per transaction (ADVICE r3-high) ----------------
 
 
-def test_eos_fanout_whole_tree_single_txn(run):
-    """One spout entry fanning out to multiple sink tuples must commit ALL
-    its outputs + its source offsets in ONE transaction even when txn_batch
-    would split the tree (ADVICE r3-high, sink.py fold-on-first-sight).
-    A recording txn asserts, at every commit, that a committed source
-    offset is fully covered by its tree's outputs already in the topic —
-    never an offset ahead of unproduced siblings."""
-    from storm_tpu.connectors import TransactionalBrokerSink
+def _eos_fanout_harness(group: str, fan: int, violations: list):
+    """Shared fixtures for the EOS fan-out tests: a broker whose
+    transactions record, at every commit, (a) duplicate output values and
+    (b) any committed source offset not fully covered by its tree's
+    outputs in the topic — the two ways a split tree breaks exactly-once —
+    plus the 1->fan splitter bolt that creates such trees."""
     from storm_tpu.runtime import Bolt, Values
-    from storm_tpu.runtime.cluster import AsyncLocalCluster
-
-    G = "eos-fan"
-    FAN = 3
-    violations = []
 
     class RecTxn:
         def __init__(self, inner, broker):
@@ -700,16 +693,19 @@ def test_eos_fanout_whole_tree_single_txn(run):
 
         def commit(self):
             self._inner.commit()
-            out_vals = {r.value.decode()
-                        for r in self._broker.drain_topic("out")}
+            out_vals = [r.value.decode()
+                        for r in self._broker.drain_topic("out")]
+            if len(out_vals) != len(set(out_vals)):
+                violations.append(("dupes", sorted(out_vals)))
+            uniq = set(out_vals)
             for p in range(2):
-                k = self._broker.committed(G, "in", p)
+                k = self._broker.committed(group, "in", p)
                 if k is None:
                     continue
                 for rec in self._broker.fetch("in", p, 0, 100)[:k]:
                     v = rec.value.decode()
-                    missing = [j for j in range(FAN)
-                               if f"{v}/{j}" not in out_vals]
+                    missing = [j for j in range(fan)
+                               if f"{v}/{j}" not in uniq]
                     if missing:
                         violations.append((v, missing))
 
@@ -719,10 +715,28 @@ def test_eos_fanout_whole_tree_single_txn(run):
 
     class SplitBolt(Bolt):
         async def execute(self, t):
-            for j in range(FAN):
+            for j in range(fan):
                 await self.collector.emit(
                     Values([f'{t.get("message")}/{j}']), anchors=[t])
             self.collector.ack(t)
+
+    return RecBroker, SplitBolt
+
+
+def test_eos_fanout_whole_tree_single_txn(run):
+    """One spout entry fanning out to multiple sink tuples must commit ALL
+    its outputs + its source offsets in ONE transaction even when txn_batch
+    would split the tree (ADVICE r3-high, sink.py fold-on-first-sight).
+    A recording txn asserts, at every commit, that a committed source
+    offset is fully covered by its tree's outputs already in the topic —
+    never an offset ahead of unproduced siblings."""
+    from storm_tpu.connectors import TransactionalBrokerSink
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    G = "eos-fan"
+    FAN = 3
+    violations = []
+    RecBroker, SplitBolt = _eos_fanout_harness(G, FAN, violations)
 
     async def main():
         broker = RecBroker(default_partitions=2)
@@ -801,51 +815,7 @@ def test_eos_fanout_sibling_failure_no_partial_commit(run):
     G = "eos-fail"
     FAN = 3
     violations = []
-
-    class RecTxn:
-        def __init__(self, inner, broker):
-            self._inner, self._broker = inner, broker
-
-        def begin(self):
-            self._inner.begin()
-
-        def produce(self, *a, **kw):
-            self._inner.produce(*a, **kw)
-
-        def send_offsets(self, *a, **kw):
-            self._inner.send_offsets(*a, **kw)
-
-        def abort(self):
-            self._inner.abort()
-
-        def commit(self):
-            self._inner.commit()
-            out_vals = [r.value.decode()
-                        for r in self._broker.drain_topic("out")]
-            if len(out_vals) != len(set(out_vals)):
-                violations.append(("dupes", sorted(out_vals)))
-            uniq = set(out_vals)
-            for p in range(2):
-                k = self._broker.committed(G, "in", p)
-                if k is None:
-                    continue
-                for rec in self._broker.fetch("in", p, 0, 100)[:k]:
-                    v = rec.value.decode()
-                    missing = [j for j in range(FAN)
-                               if f"{v}/{j}" not in uniq]
-                    if missing:
-                        violations.append((v, missing))
-
-    class RecBroker(MemoryBroker):
-        def txn(self, txn_id):
-            return RecTxn(super().txn(txn_id), self)
-
-    class SplitBolt(Bolt):
-        async def execute(self, t):
-            for j in range(FAN):
-                await self.collector.emit(
-                    Values([f'{t.get("message")}/{j}']), anchors=[t])
-            self.collector.ack(t)
+    RecBroker, SplitBolt = _eos_fanout_harness(G, FAN, violations)
 
     class FlakyPass(Bolt):
         failed = False

@@ -1231,3 +1231,35 @@ def test_read_committed_fetch_past_abort_marker(stub):
     rc2 = b.client.fetch("rcm", 0, 2, isolation="read_committed")
     assert [r.value for r in rc2] == [b"real"], [r.value for r in rc2]
     b.close()
+
+
+def test_api_versions_probe_parses_error_35(stub):
+    """UNSUPPORTED_VERSION (35) replies still carry the supported-versions
+    array (KIP-511): the probe must parse and validate it rather than
+    treating the error as a silent no-answer — a modern broker answering
+    v0 with error 35 is exactly what the loud KIP-896 check exists for
+    (ADVICE r3-low)."""
+    from storm_tpu.connectors.kafka_protocol import PINNED_API_VERSIONS
+
+    # error 35 + modern ranges: must fail LOUDLY, not bypass the check
+    stub.api_versions = ("error35",
+                         {key: (9, 17) for key in PINNED_API_VERSIONS})
+    c = KafkaWireClient(f"127.0.0.1:{stub.port}")
+    try:
+        advertised = c.probe_api_versions()
+        assert advertised is not None and advertised[0] == (9, 17)
+        with pytest.raises(KafkaProtocolError, match="KIP-896"):
+            c.refresh_metadata(["t"])
+    finally:
+        c.close()
+        stub.api_versions = None
+
+    # error 35 + EMPTY array: nothing to learn -> era-compatible assumed
+    stub.api_versions = ("error35", {})
+    c2 = KafkaWireClient(f"127.0.0.1:{stub.port}")
+    try:
+        assert c2.probe_api_versions() is None
+        c2.refresh_metadata(["t"])  # proceeds
+    finally:
+        c2.close()
+        stub.api_versions = None

@@ -99,6 +99,38 @@ def test_checkpoint_roundtrip(tmp_path):
     assert jax.tree.all(jax.tree.map(lambda a, b: bool(jnp.all(a == b)), params, params2))
 
 
+def test_checkpoint_hyper_mismatch_refused(tmp_path):
+    """A checkpoint records compute-relevant hyperparameters that param
+    shapes can't encode (num_heads: attention projections are dim x dim
+    for any head count). Loading it into a model built with different
+    ones must fail loudly, not silently compute differently-partitioned
+    attention (ADVICE r3 medium, longseq num_heads 8 -> 2)."""
+    import pytest
+
+    from storm_tpu.models.registry import load_or_init, save_checkpoint
+
+    m2 = build_model("longseq_tiny")  # num_heads=4 default
+    params, state = init_params(m2, 0)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, params, state, model=m2)
+
+    # same param shapes, different head partitioning -> refused
+    m8 = build_model("longseq_tiny", num_heads=8)
+    with pytest.raises(ValueError, match="num_heads"):
+        load_or_init(m8, path, seed=0)
+
+    # matching hyper loads fine
+    params2, _ = load_or_init(build_model("longseq_tiny"), path, seed=99)
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: bool(jnp.all(a == b)), params, params2))
+
+    # pre-sidecar checkpoints (no hyper file) still load best-effort
+    import os
+
+    os.remove(os.path.join(path, "storm_tpu_hyper.json"))
+    load_or_init(m8, path, seed=0)
+
+
 # ---- MoE-ViT -----------------------------------------------------------------
 
 
