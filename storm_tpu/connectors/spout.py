@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import logging
 import re
 import threading
 import time
@@ -34,6 +35,8 @@ from storm_tpu.config import OffsetsConfig
 from storm_tpu.connectors.memory import MemoryBroker, Record
 from storm_tpu.runtime.base import Spout, TopologyContext, OutputCollector
 from storm_tpu.runtime.tuples import Values
+
+log = logging.getLogger("storm_tpu.spout")
 
 
 def parse_seek_position(s):
@@ -125,6 +128,18 @@ class BrokerSpout(Spout):
         # Streams' per-partition processing model; cross-partition
         # parallelism and chunking carry the throughput.
         self._txn_mode = cfg.policy == "txn"
+        if self._txn_mode and max(1, self.chunk) < 64:
+            # Measured cliff, not a guess: exactly-once delivery is ordered
+            # depth-1 per partition, so throughput rides entirely on entry
+            # size — chunk >= 64 benched FREE vs at-least-once while
+            # chunk=16 cost ~5x (BENCH_NOTES.md "what does exactly-once
+            # cost"). Loud because the default chunk silently hits it.
+            log.warning(
+                "offsets.policy='txn' with spout chunk %d: exactly-once "
+                "delivers one entry per partition at a time, and entries "
+                "this small cost ~5x throughput (measured; free at chunk "
+                ">= 64). Set topology.spout_chunk >= 64 — see "
+                "docs/OPERATIONS.md#exactly-once.", max(1, self.chunk))
         self._part_inflight: Dict[int, int] = {}
         for p in self.my_partitions:
             self.positions[p] = self._initial_position(p)

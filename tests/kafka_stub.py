@@ -46,8 +46,20 @@ class KafkaStubBroker:
     #: the rest of the suite uses (nothing visible before commit).
     log_transactional = False
 
-    def __init__(self, partitions: int = 2) -> None:
+    def __init__(self, partitions: int = 2, nodes: int = 1) -> None:
+        """``nodes > 1`` runs extra listeners that share ALL state (logs,
+        groups, transactions) but have distinct node ids/ports — enough to
+        move a partition leader or the coordinator mid-stream and exercise
+        the client's election-survival path: a non-leader node answers
+        produce/fetch/list_offsets with NOT_LEADER_FOR_PARTITION (6) and a
+        non-coordinator node answers group/txn RPCs with NOT_COORDINATOR
+        (16), exactly like a real broker after the metadata moved."""
         self.partitions = partitions
+        self.nodes = nodes
+        #: (topic, partition) -> leader node id (missing = node 0)
+        self._leaders: Dict[Tuple[str, int], int] = {}
+        #: node answering group + txn coordinator RPCs
+        self._coord_node = 0
         self._logs: Dict[Tuple[str, int], List[Tuple[Optional[bytes], bytes, float]]] = {}
         self._topics: Dict[str, int] = {}
         self._commits: Dict[Tuple[str, str, int], int] = {}
@@ -77,30 +89,48 @@ class KafkaStubBroker:
         # committed records.
         self._aborted: Dict[Tuple[str, int], List[Tuple[int, int, int]]] = {}
         self._lock = threading.Lock()
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("127.0.0.1", 0))
-        self._sock.listen(16)
-        self.port = self._sock.getsockname()[1]
+        self._socks: List[socket.socket] = []
+        self.ports: List[int] = []
         self._running = True
         self._threads: List[threading.Thread] = []
-        t = threading.Thread(target=self._accept_loop, daemon=True)
-        t.start()
-        self._threads.append(t)
+        for node in range(nodes):
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind(("127.0.0.1", 0))
+            sock.listen(16)
+            self._socks.append(sock)
+            self.ports.append(sock.getsockname()[1])
+            t = threading.Thread(target=self._accept_loop,
+                                 args=(sock, node), daemon=True)
+            t.start()
+            self._threads.append(t)
+        self.port = self.ports[0]
+
+    # ---- leadership / coordinator moves (election simulation) ----------------
+
+    def move_leader(self, topic: str, partition: int, node: int) -> None:
+        with self._lock:
+            self._ensure(topic)
+            self._leaders[(topic, partition)] = node
+
+    def move_coordinator(self, node: int) -> None:
+        with self._lock:
+            self._coord_node = node
 
     # ---- plumbing ------------------------------------------------------------
 
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, sock: socket.socket, node: int) -> None:
         while self._running:
             try:
-                conn, _ = self._sock.accept()
+                conn, _ = sock.accept()
             except OSError:
                 return
-            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t = threading.Thread(target=self._serve, args=(conn, node),
+                                 daemon=True)
             t.start()
             self._threads.append(t)
 
-    def _serve(self, conn: socket.socket) -> None:
+    def _serve(self, conn: socket.socket, node: int = 0) -> None:
         try:
             while True:
                 head = self._recv(conn, 4)
@@ -115,7 +145,7 @@ class KafkaStubBroker:
                 api_version = r.i16()
                 corr = r.i32()
                 r.string()  # client id
-                body = self._dispatch(api_key, api_version, r)
+                body = self._dispatch(api_key, api_version, r, node)
                 resp = struct.pack(">i", corr) + body
                 conn.sendall(struct.pack(">i", len(resp)) + resp)
         except (OSError, Exception):
@@ -135,10 +165,11 @@ class KafkaStubBroker:
 
     def close(self) -> None:
         self._running = False
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        for sock in self._socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     # ---- state helpers -------------------------------------------------------
 
@@ -155,23 +186,28 @@ class KafkaStubBroker:
 
     # ---- api dispatch --------------------------------------------------------
 
-    def _dispatch(self, api: int, version: int, r: Reader) -> bytes:
+    def _dispatch(self, api: int, version: int, r: Reader,
+                  node: int = 0) -> bytes:
         if api == 18:
             return self._api_versions(r)
         if api == 3:
             return self._metadata(r)
         if api == 0:
-            return self._produce(r, version)
+            return self._produce(r, version, node)
         if api == 1:
-            return self._fetch(r, version)
+            return self._fetch(r, version, node)
         if api == 2:
-            return self._list_offsets(r)
+            return self._list_offsets(r, node)
         if api == 10:
             return self._find_coordinator(r, version)
+        # Coordinator-owned RPCs: a node that is NOT the coordinator
+        # answers NOT_COORDINATOR (16) in the API's error slot, like a
+        # real broker after the coordinator moved.
+        not_coord = node != self._coord_node
         if api == 8:
-            return self._offset_commit(r)
+            return self._offset_commit(r, err_override=16 if not_coord else 0)
         if api == 9:
-            return self._offset_fetch(r)
+            return self._offset_fetch(r, err_override=16 if not_coord else 0)
         if api == 11:
             return self._join_group(r)
         if api == 14:
@@ -181,16 +217,22 @@ class KafkaStubBroker:
         if api == 13:
             return self._leave_group(r)
         if api == 22:
-            return self._init_producer_id(r)
+            return self._init_producer_id(r) if not not_coord \
+                else bytes(Writer().i32(0).i16(16).i64(-1).i16(-1).buf)
         if api == 24:
-            return self._add_partitions_to_txn(r)
+            return self._add_partitions_to_txn(r, err_override=16) \
+                if not_coord else self._add_partitions_to_txn(r)
         if api == 25:
-            return self._add_offsets_to_txn(r)
+            return self._add_offsets_to_txn(r) if not not_coord \
+                else bytes(Writer().i32(0).i16(16).buf)
         if api == 26:
-            return self._end_txn(r)
+            return self._end_txn(r) if not not_coord \
+                else bytes(Writer().i32(0).i16(16).buf)
         if api == 28:
-            return self._txn_offset_commit(r)
+            return self._txn_offset_commit(r, err_override=16) \
+                if not_coord else self._txn_offset_commit(r)
         raise RuntimeError(f"stub does not implement api {api}")
+
 
     def _api_versions(self, r: Reader) -> bytes:
         if self.api_versions == "closed":
@@ -223,16 +265,18 @@ class KafkaStubBroker:
                 self._ensure(t)
             listing = {t: self._topics[t] for t in (topics or self._topics)}
         w = Writer()
-        w.i32(1)  # one broker
-        w.i32(0).string("127.0.0.1").i32(self.port)
+        w.i32(self.nodes)
+        for node in range(self.nodes):
+            w.i32(node).string("127.0.0.1").i32(self.ports[node])
         w.i32(len(listing))
         for t, nparts in listing.items():
             w.i16(0).string(t)
             w.i32(nparts)
             for p in range(nparts):
-                w.i16(0).i32(p).i32(0)  # leader node 0
-                w.i32(1).i32(0)  # replicas
-                w.i32(1).i32(0)  # isr
+                leader = self._leaders.get((t, p), 0)
+                w.i16(0).i32(p).i32(leader)
+                w.i32(1).i32(leader)  # replicas
+                w.i32(1).i32(leader)  # isr
         return bytes(w.buf)
 
     def _init_producer_id(self, r: Reader) -> bytes:
@@ -285,7 +329,8 @@ class KafkaStubBroker:
             return None, 47
         return st, 0
 
-    def _add_partitions_to_txn(self, r: Reader) -> bytes:
+    def _add_partitions_to_txn(self, r: Reader,
+                               err_override: int = 0) -> bytes:
         txn_id = r.string()
         pid = r.i64()
         epoch = r.i16()
@@ -296,10 +341,13 @@ class KafkaStubBroker:
                 topics.append((t, r.i32()))
         w = Writer()
         w.i32(0)  # throttle
-        with self._lock:
-            st, err = self._txn_check(txn_id, pid, epoch)
-            if not err:
-                st["parts"].update(topics)
+        if err_override:
+            err = err_override
+        else:
+            with self._lock:
+                st, err = self._txn_check(txn_id, pid, epoch)
+                if not err:
+                    st["parts"].update(topics)
         by_topic: Dict[str, List[int]] = {}
         for t, p in topics:
             by_topic.setdefault(t, []).append(p)
@@ -326,7 +374,7 @@ class KafkaStubBroker:
         w.i32(0).i16(err)  # throttle, error
         return bytes(w.buf)
 
-    def _txn_offset_commit(self, r: Reader) -> bytes:
+    def _txn_offset_commit(self, r: Reader, err_override: int = 0) -> bytes:
         """TxnOffsetCommit v0: stage offsets inside the open transaction —
         visible in OffsetFetch only after EndTxn(commit)."""
         txn_id = r.string()
@@ -339,9 +387,12 @@ class KafkaStubBroker:
         n_topics = r.i32()
         w.i32(n_topics)
         with self._lock:
-            st, err = self._txn_check(txn_id, pid, epoch)
-            if not err and group not in st["offset_groups"]:
-                err = 48  # group not registered via AddOffsetsToTxn
+            if err_override:
+                st, err = None, err_override
+            else:
+                st, err = self._txn_check(txn_id, pid, epoch)
+                if not err and group not in st["offset_groups"]:
+                    err = 48  # group not registered via AddOffsetsToTxn
             for _ in range(n_topics):
                 topic = r.string()
                 w.string(topic)
@@ -418,7 +469,7 @@ class KafkaStubBroker:
         count, = struct.unpack(">i", data[57:61])
         return prod_id, base_seq, count, epoch
 
-    def _produce(self, r: Reader, version: int = 2) -> bytes:
+    def _produce(self, r: Reader, version: int = 2, node: int = 0) -> bytes:
         txn_id = r.string() if version >= 3 else None
         r.i16()  # acks
         r.i32()  # timeout
@@ -433,6 +484,9 @@ class KafkaStubBroker:
             for _ in range(n_parts):
                 pid = r.i32()
                 data = r.bytes_() or b""
+                if self._leaders.get((topic, pid), 0) != node:
+                    w.i32(pid).i16(6).i64(-1).i64(-1)  # NOT_LEADER
+                    continue
                 prod = self._batch_producer_fields(data)
                 err = 0
                 with self._lock:
@@ -524,7 +578,7 @@ class KafkaStubBroker:
             i += len(run)
         return bytes(out)
 
-    def _fetch(self, r: Reader, version: int = 2) -> bytes:
+    def _fetch(self, r: Reader, version: int = 2, node: int = 0) -> bytes:
         r.i32()  # replica
         r.i32()  # max wait
         r.i32()  # min bytes
@@ -546,6 +600,12 @@ class KafkaStubBroker:
                 pid = r.i32()
                 offset = r.i64()
                 r.i32()  # max bytes
+                if self._leaders.get((topic, pid), 0) != node:
+                    w.i32(pid).i16(6).i64(-1)  # NOT_LEADER
+                    if version >= 4:
+                        w.i64(-1).i32(0)
+                    w.bytes_(b"")
+                    continue
                 with self._lock:
                     self._ensure(topic)
                     log = self._logs[(topic, pid)]
@@ -594,7 +654,7 @@ class KafkaStubBroker:
                 w.bytes_(msgset)
         return bytes(w.buf)
 
-    def _list_offsets(self, r: Reader) -> bytes:
+    def _list_offsets(self, r: Reader, node: int = 0) -> bytes:
         r.i32()  # replica
         w = Writer()
         n_topics = r.i32()
@@ -608,6 +668,9 @@ class KafkaStubBroker:
                 pid = r.i32()
                 ts = r.i64()
                 r.i32()  # max offsets
+                if self._leaders.get((topic, pid), 0) != node:
+                    w.i32(pid).i16(6).i32(0)  # NOT_LEADER, no offsets
+                    continue
                 with self._lock:
                     self._ensure(topic)
                     end = len(self._logs[(topic, pid)])
@@ -626,10 +689,11 @@ class KafkaStubBroker:
             w.string(None)  # error_message
         else:
             w.i16(0)
-        w.i32(0).string("127.0.0.1").i32(self.port)
+        coord = self._coord_node
+        w.i32(coord).string("127.0.0.1").i32(self.ports[coord])
         return bytes(w.buf)
 
-    def _offset_commit(self, r: Reader) -> bytes:
+    def _offset_commit(self, r: Reader, err_override: int = 0) -> bytes:
         group = r.string()
         r.i32()  # generation
         r.string()  # member
@@ -646,12 +710,13 @@ class KafkaStubBroker:
                 pid = r.i32()
                 off = r.i64()
                 r.string()  # metadata
-                with self._lock:
-                    self._commits[(group, topic, pid)] = off
-                w.i32(pid).i16(0)
+                if not err_override:
+                    with self._lock:
+                        self._commits[(group, topic, pid)] = off
+                w.i32(pid).i16(err_override)
         return bytes(w.buf)
 
-    def _offset_fetch(self, r: Reader) -> bytes:
+    def _offset_fetch(self, r: Reader, err_override: int = 0) -> bytes:
         group = r.string()
         w = Writer()
         n_topics = r.i32()
@@ -663,6 +728,9 @@ class KafkaStubBroker:
             w.i32(n_parts)
             for _ in range(n_parts):
                 pid = r.i32()
+                if err_override:
+                    w.i32(pid).i64(-1).string(None).i16(err_override)
+                    continue
                 with self._lock:
                     off = self._commits.get((group, topic, pid), -1)
                 w.i32(pid).i64(off).string(None).i16(0)
