@@ -585,6 +585,173 @@ def run_latency_breakdown(args) -> dict:
     }
 
 
+def run_slo_sweep(args) -> dict:
+    """``--slo-sweep``: the JOINT north star measured jointly (VERDICT r3
+    missing #2). The target is throughput AND latency at once — ">=10k
+    img/s on v5e-8 at p50 < 50 ms" — but every prior artifact measured one
+    axis at a fixed operating point of the other. This sweeps the offered
+    rate across the topology's operating range and reports, from the same
+    measured curve:
+
+    - latency vs offered rate (the reference's own thesis curve,
+      README.md:13-14: "produce faster -> latency rises");
+    - the SLO-constrained operating points: max measured rate whose e2e
+      p50 (append->deliver) stays under 50 / 100 / 200 ms;
+    - per-stage p50 attribution at every point, so the environment's
+      share (device + dispatch queue = the ~200 ms tunnel here) is
+      separable from the framework's share per point;
+    - the same sweep with a NullEngine (device time = 0): the framework's
+      own latency-vs-rate curve, i.e. what the identical pipeline would
+      serve with a local (non-tunneled) chip.
+    """
+    import jax
+
+    from storm_tpu.config import BatchConfig
+    from storm_tpu.connectors import MemoryBroker
+    from storm_tpu.infer import NullEngine
+    from storm_tpu.runtime.cluster import LocalCluster
+
+    cfg = CONFIGS[args.config]
+    if "model" not in cfg:
+        sys.exit("--slo-sweep needs a single-model config")
+    n_dev = len(jax.devices())
+    log(f"devices: {jax.devices()}")
+    buckets = cfg["buckets"]
+    ipm = args.instances_per_msg
+
+    def sweep(framework_only: bool, topo_name: str) -> list:
+        cluster = LocalCluster()
+        try:
+            broker = MemoryBroker(default_partitions=4)
+            batch_cfg = BatchConfig(
+                max_batch=args.max_batch or cfg["max_batch"],
+                max_wait_ms=args.max_wait_ms,
+                buckets=buckets,
+                max_inflight=args.inflight or 2,
+                eager=args.eager,
+            )
+            engine = (NullEngine(cfg["input_shape"], cfg["num_classes"])
+                      if framework_only else None)
+            run_cfg, topo = build_topology(
+                cfg, broker, batch_cfg,
+                None if framework_only else args.transfer_dtype, args.chunk,
+                "float" if framework_only else args.weights, engine=engine)
+            t0 = time.time()
+            cluster.submit_topology(topo_name, run_cfg, topo)
+            log(f"  submitted + warmed up in {time.time() - t0:.1f}s")
+            payloads = make_payloads(cfg, instances_per_msg=ipm)
+
+            def produce_nth(i):
+                broker.produce("input", payloads[i % len(payloads)])
+
+            def out_size():
+                return broker.topic_size("output")
+
+            def read_lat():
+                lat = cluster.metrics(topo_name)["kafka-bolt"]["e2e_latency_ms"]
+                return (lat["p50"] if lat["p50"] is not None else float("nan"),
+                        lat["p99"] if lat["p99"] is not None else float("nan"))
+
+            # calibrate capacity with a drain burst (the latency-protocol
+            # calibration, shared rationale with run_latency_phase)
+            probe = 96
+            base = out_size()
+            t0 = time.perf_counter()
+            for i in range(probe):
+                produce_nth(i)
+            if not await_outputs(lambda: out_size() - base, probe,
+                                 grace_s=180.0):
+                log("  calibration probe incomplete; sweep aborted")
+                return []
+            cap = max(out_size() - base, 1) / (time.perf_counter() - t0)
+            log(f"  calibrated capacity ~{cap:.0f} msg/s")
+
+            points = []
+            for frac in (0.15, 0.3, 0.45, 0.6, 0.75, 0.9, 1.0, 1.15):
+                rate = max(2.0, cap * frac)
+                base = out_size()
+                reset_stage_hists(cluster, topo_name)
+                sent, aborted = offer_load(
+                    produce_nth, rate, args.sweep_seconds,
+                    backlog_fn=lambda s: s - (out_size() - base))
+                drained = await_outputs(lambda: out_size() - base, sent,
+                                        grace_s=90.0)
+                p50, p99 = read_lat()
+                point = {
+                    "offered_msg_s": round(rate, 1),
+                    "offered_img_s": round(rate * ipm, 1),
+                    "fraction_of_capacity": frac,
+                    "p50_ms": round(p50, 1) if p50 == p50 else None,
+                    "p99_ms": round(p99, 1) if p99 == p99 else None,
+                    "valid": bool(not aborted and drained),
+                    "stages_p50_ms": read_stage_p50s(cluster, topo_name),
+                }
+                points.append(point)
+                log(f"  rate {rate:7.1f} msg/s ({frac:.2f}x cap): "
+                    f"p50={point['p50_ms']} p99={point['p99_ms']} "
+                    f"{'ok' if point['valid'] else 'SATURATED'}")
+                if aborted:
+                    # past the knee: higher rates only measure queueing
+                    if not await_outputs(lambda: out_size() - base, sent,
+                                         grace_s=120.0):
+                        log("  backlog never cleared; stopping sweep")
+                        break
+            return points
+        finally:
+            cluster.shutdown()
+
+    log("== device-path sweep ==")
+    device_curve = sweep(False, "slo-dev")
+    log("== framework-only sweep (NullEngine) ==")
+    fw_curve = sweep(True, "slo-fw")
+
+    def slo_points(curve):
+        out = {}
+        for slo in (50.0, 100.0, 200.0):
+            ok = [p for p in curve
+                  if p["valid"] and p["p50_ms"] is not None
+                  and p["p50_ms"] <= slo]
+            out[f"p50_le_{int(slo)}ms"] = (
+                max(ok, key=lambda p: p["offered_img_s"]) if ok else None)
+        return out
+
+    dev_pts = slo_points(device_curve)
+    fw_pts = slo_points(fw_curve)
+    best50 = dev_pts["p50_le_50ms"]
+    headline = (round(best50["offered_img_s"] / n_dev, 1)
+                if best50 else None)
+    out = {
+        "metric": f"{cfg['metric']}_img_s_per_chip_at_p50_le_50ms",
+        "value": headline,
+        "unit": "images/sec/chip under measured e2e p50 <= 50 ms",
+        "vs_baseline": (round(headline / BASELINE_IMGS_PER_SEC_PER_CHIP, 3)
+                        if headline else None),
+        "chips": n_dev,
+        "config": f"{args.config}+slo-sweep",
+        "instances_per_msg": ipm,
+        "device_curve": device_curve,
+        "device_slo_points": dev_pts,
+        "framework_curve": fw_curve,
+        "framework_slo_points": fw_pts,
+        "note": ("device-path latency here includes the benching "
+                 "environment's ~200 ms tunneled-device floor (see "
+                 "stages_p50_ms: device + dispatch_queue); the "
+                 "framework_curve bounds what the identical pipeline "
+                 "serves with a local chip"),
+    }
+    if best50 is None and device_curve:
+        # per the done-criterion: show exactly WHERE the 50 ms budget goes
+        # when it is unreachable, per stage, at the lightest load point
+        lightest = device_curve[0]["stages_p50_ms"]
+        blame = max(lightest, key=lambda k: lightest[k])
+        out["p50_le_50ms_unreachable_because"] = (
+            f"stage '{blame}' alone is {lightest[blame]:.0f} ms at the "
+            f"lightest offered rate (full stage p50s in device_curve[0]); "
+            "the framework_slo_points show the identical pipeline meets "
+            "the SLO when device time is excluded")
+    return out
+
+
 def run_autoscale(args) -> dict:
     """``--autoscale``: the reference's scaling thesis as a measured closed
     loop (README.md:13-14 — "input rate rises, latency grows -> scale the
@@ -849,7 +1016,16 @@ def main() -> None:
                          "~3x the tunnel-floor p50 in this environment)")
     ap.add_argument("--stage-seconds", type=float, default=20.0,
                     help="seconds per offered-load stage in --autoscale")
+    ap.add_argument("--slo-sweep", action="store_true",
+                    help="sweep offered rate; report latency-vs-rate curve "
+                         "+ max img/s/chip under measured p50 <= 50/100/"
+                         "200 ms (the joint north star, VERDICT r3 #2)")
+    ap.add_argument("--sweep-seconds", type=float, default=8.0,
+                    help="seconds per rate point in --slo-sweep")
     args = ap.parse_args()
+    if args.slo_sweep:
+        print(json.dumps(run_slo_sweep(args)))
+        return
     if args.autoscale:
         print(json.dumps(run_autoscale(args)))
         return

@@ -84,13 +84,13 @@ def train_to_convergence(
     from storm_tpu.models.registry import init_params
     from storm_tpu.parallel.train import make_train_step
 
-    train_step, opt = make_train_step(model, learning_rate=learning_rate)
     if mesh is not None:
         from storm_tpu.parallel.train import init_sharded_training
 
         train_step, params, opt_state, state = init_sharded_training(
             model, mesh, seed=seed, learning_rate=learning_rate)
     else:
+        train_step, opt = make_train_step(model, learning_rate=learning_rate)
         params, state = init_params(model, seed)
         opt_state = jax.jit(opt.init)(params)
 

@@ -176,6 +176,16 @@ class InferenceEngine:
         )
         self.data_axis = ("data" if axis2
                           else self.sharding_cfg.axis_names[0])
+        # Multi-process serving (global mesh spanning several OS
+        # processes, e.g. multi-host slices): device_put of the SAME host
+        # batch from every process onto a global sharding is the SPMD
+        # contract jax supports natively, but fetching results needs an
+        # explicit cross-process allgather — np.asarray on a
+        # non-fully-addressable array raises. Certified by
+        # tests/test_dist.py::test_multiprocess_serving.
+        self._multiprocess = any(
+            d.process_index != jax.process_index()
+            for d in self.mesh.devices.flat)
         self._lock = threading.Lock()
 
         params, state = load_or_init(self.model, model_cfg.checkpoint, model_cfg.seed)
@@ -226,6 +236,17 @@ class InferenceEngine:
             place_params = lambda t: shard_params_ep(self.mesh, t, "expert")
         else:
             place_params = lambda t: jax.device_put(t, replicated(self.mesh))
+        # Cross-process placement only accepts HOST buffers (each process
+        # supplies the same value and jax takes its local shards); a
+        # committed single-device jax array would demand a cross-host
+        # device transfer the backend refuses. Init/orbax hand us
+        # committed arrays, so materialize to numpy first.
+        _hostify = (lambda t: jax.tree.map(
+            lambda a: np.asarray(a) if hasattr(a, "dtype") else a, t)
+        ) if self._multiprocess else (lambda t: t)
+        if self._multiprocess:
+            _inner_place = place_params
+            place_params = lambda t: _inner_place(_hostify(t))
         # BN statistics stay f32 (cast only f32 leaves to compute dtype would
         # nuke them too) — so cast params only; state is small and stays f32.
         self._w8 = getattr(model_cfg, "weights", "float") in (
@@ -245,7 +266,7 @@ class InferenceEngine:
             self.params = place_params(qtree)
         else:
             self.params = place_params(cast(params))
-        self.state = jax.device_put(state, replicated(self.mesh))
+        self.state = jax.device_put(_hostify(state), replicated(self.mesh))
         # jit must pin params to their committed placement (replicated OR
         # TP-sharded) — read the shardings off the placed arrays so both
         # paths share one code path.
@@ -393,6 +414,10 @@ class InferenceEngine:
                 xd = jax.device_put(x, self._x_sharding)
                 out = self._fwd(self.params, self.state, xd)
         self.compiled_batches.add(padded)
+        if self._multiprocess:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(out, tiled=True)[:n]
         return np.asarray(out)[:n]
 
 

@@ -1,0 +1,75 @@
+"""Worker half of the MULTI-PROCESS serving certification: N OS processes,
+4 CPU devices each, ONE global mesh — the SERVING engine (the product:
+JSON decode -> InferenceEngine.predict -> JSON encode, the InferenceBolt
+hot path) runs with its params placed over the global mesh and its
+collectives crossing the process boundary. Run by
+tests/test_dist.py::test_multiprocess_serving via subprocess.
+
+SPMD contract: every process feeds the SAME batch (the bolt on each host
+receives the same replicated record stream slice in this certification);
+every process must print byte-identical predictions.
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+pid, nproc, port, mode = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+                          sys.argv[4])
+if nproc > 1:
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nproc, process_id=pid)
+
+import json  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from storm_tpu.api.schema import (decode_instances,  # noqa: E402
+                                  decode_predictions, encode_predictions)
+from storm_tpu.config import BatchConfig, ModelConfig  # noqa: E402
+from storm_tpu.infer.engine import InferenceEngine  # noqa: E402
+from storm_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+devs = jax.devices()
+# global mesh is always 8 devices: nproc processes x (8/nproc) local
+assert len(devs) == 8, devs
+if mode == "dp":
+    mesh = make_mesh(len(devs), 1, devices=devs)
+elif mode == "dptp":
+    mesh = make_mesh(len(devs) // 2, 2, devices=devs)
+else:
+    raise SystemExit(f"unknown mode {mode}")
+
+ckpt = str(pathlib.Path(__file__).resolve().parents[1]
+           / "checkpoints" / "vit_tiny_digits")
+engine = InferenceEngine(
+    ModelConfig(name="vit_tiny", checkpoint=ckpt, input_shape=(32, 32, 3),
+                num_classes=10),
+    mesh=mesh,
+    batch_cfg=BatchConfig(max_batch=8, buckets=(8,)),
+)
+
+# the bolt's wire path on a deterministic batch
+rng = np.random.RandomState(7)
+x = rng.rand(8, 32, 32, 3).astype(np.float32)
+payload = json.dumps({"instances": x.tolist()})
+inst = decode_instances(payload)
+preds = engine.predict(inst.data)
+wire = encode_predictions(preds)
+roundtrip = decode_predictions(wire).data
+assert roundtrip.shape == (8, 10)
+assert np.allclose(roundtrip, preds, atol=1e-6)  # wire is value-faithful
+
+# certify the FULL prediction tensor, not a truncated prefix: a
+# wrong-order shard reassembly must change this digest
+import hashlib  # noqa: E402
+
+digest = hashlib.sha256(
+    np.round(np.asarray(preds, np.float64), 5).tobytes()).hexdigest()
+print(f"MH-SERVE-OK proc={pid} mode={mode} preds={digest} "
+      f"argmax={np.asarray(preds).argmax(-1).tolist()}", flush=True)
+if nproc > 1:
+    jax.distributed.shutdown()
