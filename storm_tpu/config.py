@@ -261,6 +261,35 @@ class BrokerConfig:
     # an exactly-once pipeline's INPUT side should use when upstream
     # producers are transactional. Default matches pre-KIP-98 consumers.
     isolation: str = "read_uncommitted"
+    # Transport security (kind='kafka'). 0.11-era brokers already spoke
+    # SASL/SSL; the reference never configured it (MainTopology.java:
+    # 95-118) but a production contract should. SASL mechanism: PLAIN
+    # (the era's standard; tokens are raw pre-KIP-152 frames).
+    security_protocol: str = "PLAINTEXT"  # | SSL | SASL_PLAINTEXT | SASL_SSL
+    sasl_username: str = ""
+    sasl_password: str = ""
+    ssl_cafile: str = ""  # CA bundle for broker cert verification
+    # self-signed broker certs without a matching SAN: keep encryption +
+    # chain verification, skip only hostname matching
+    ssl_check_hostname: bool = True
+    # explicit, separate opt-out of CERT verification entirely
+    # (encryption without authentication — last resort)
+    ssl_verify: bool = True
+
+    def security_dict(self) -> Optional[dict]:
+        """The wire client's ``security`` parameter, or None for
+        PLAINTEXT (no handshake overhead on the default path)."""
+        if self.security_protocol == "PLAINTEXT":
+            return None
+        return {
+            "protocol": self.security_protocol,
+            "sasl_mechanism": "PLAIN",
+            "sasl_username": self.sasl_username,
+            "sasl_password": self.sasl_password,
+            "ssl_cafile": self.ssl_cafile or None,
+            "ssl_check_hostname": self.ssl_check_hostname,
+            "ssl_verify": self.ssl_verify,
+        }
 
     def __post_init__(self) -> None:
         if self.kind not in ("memory", "kafka"):
@@ -283,6 +312,16 @@ class BrokerConfig:
             raise ValueError(
                 f"broker.isolation must be read_uncommitted|read_committed, "
                 f"got {self.isolation!r}")
+        if self.security_protocol not in (
+                "PLAINTEXT", "SSL", "SASL_PLAINTEXT", "SASL_SSL"):
+            raise ValueError(
+                "broker.security_protocol must be PLAINTEXT|SSL|"
+                f"SASL_PLAINTEXT|SASL_SSL, got {self.security_protocol!r}")
+        if (self.security_protocol.startswith("SASL")
+                and not self.sasl_username):
+            raise ValueError(
+                "broker.security_protocol=SASL_* requires sasl_username "
+                "(mechanism PLAIN)")
 
 
 def _apply_section(target, values: dict) -> None:

@@ -537,12 +537,67 @@ def filter_read_committed(
 
 
 class _Conn:
-    def __init__(self, host: str, port: int, client_id: str, timeout: float) -> None:
+    def __init__(self, host: str, port: int, client_id: str, timeout: float,
+                 security: "Optional[dict]" = None) -> None:
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.client_id = client_id
         self.lock = threading.Lock()
         self._corr = 0
+        proto = (security or {}).get("protocol", "PLAINTEXT")
+        if proto in ("SSL", "SASL_SSL"):
+            import ssl as _ssl
+
+            cafile = security.get("ssl_cafile") or None
+            ctx = _ssl.create_default_context(cafile=cafile)
+            if not security.get("ssl_check_hostname", True):
+                # skips hostname/SAN matching ONLY; the chain is still
+                # verified against the CA bundle (or system CAs)
+                ctx.check_hostname = False
+            if not security.get("ssl_verify", True):
+                # explicit, separate opt-out: accept any cert (encryption
+                # without authentication — private-network last resort)
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
+            self.sock = ctx.wrap_socket(self.sock, server_hostname=host)
+        if proto in ("SASL_PLAINTEXT", "SASL_SSL"):
+            self._sasl_plain(security)
+
+    def _sasl_plain(self, security: dict) -> None:
+        """0.10/0.11-era SASL/PLAIN: a Kafka-framed SaslHandshake (api 17
+        v0) naming the mechanism, then RAW length-prefixed token frames —
+        the tokens are not wrapped in the Kafka protocol until KIP-152
+        (broker 1.0+); this client speaks the era of its pinned APIs."""
+        mech = security.get("sasl_mechanism", "PLAIN")
+        if mech != "PLAIN":
+            raise KafkaProtocolError(
+                f"unsupported sasl_mechanism {mech!r} (PLAIN only)")
+        r = self.request(17, 0, bytes(Writer().string(mech).buf))
+        err = r.i16()
+        mechs = [r.string() for _ in range(max(0, r.i32()))]
+        if err:
+            raise KafkaProtocolError(
+                f"SaslHandshake({mech}) refused: error {err} "
+                f"({ERROR_NAMES.get(err, 'UNKNOWN')}); broker offers "
+                f"{mechs}", code=err)
+        user = security.get("sasl_username") or ""
+        pwd = security.get("sasl_password") or ""
+        token = b"\x00" + user.encode() + b"\x00" + pwd.encode()
+        with self.lock:
+            # success = an (empty) server token; failure = broker closes
+            # (FIN -> KafkaProtocolError from _recv, RST -> OSError) —
+            # both must surface AS an auth failure, not leak out as a
+            # transport error the leader-retry path would re-auth against
+            # with the same bad credentials.
+            try:
+                self.sock.sendall(struct.pack(">i", len(token)) + token)
+                size = struct.unpack(">i", self._recv(4))[0]
+                if size > 0:
+                    self._recv(size)
+            except (KafkaProtocolError, OSError) as e:
+                raise KafkaProtocolError(
+                    "SASL/PLAIN authentication failed (broker closed the "
+                    f"connection): {e}") from e
 
     def request(
         self, api_key: int, api_version: int, body: bytes, oneway: bool = False
@@ -648,11 +703,18 @@ class KafkaWireClient:
         bootstrap: str,
         client_id: str = "storm-tpu",
         timeout: float = 30.0,
+        security: "Optional[dict]" = None,
     ) -> None:
+        """``security``: None/PLAINTEXT, or a dict with ``protocol``
+        ('SSL' | 'SASL_PLAINTEXT' | 'SASL_SSL'), ``sasl_mechanism``
+        ('PLAIN'), ``sasl_username``/``sasl_password``, ``ssl_cafile``,
+        ``ssl_check_hostname`` — applied to EVERY broker connection
+        (cached, probe, coordinator)."""
         host, _, port = bootstrap.partition(":")
         self.bootstrap = (host, int(port or 9092))
         self.client_id = client_id
         self.timeout = timeout
+        self.security = security
         self._conns: Dict[Tuple[str, int], _Conn] = {}
         self._conn_locks: Dict[Tuple[str, int], threading.Lock] = {}
         self._brokers: Dict[int, Tuple[str, int]] = {}
@@ -683,7 +745,8 @@ class KafkaWireClient:
                 c = self._conns.get(addr)
                 if c is not None:
                     return c
-            c = _Conn(addr[0], addr[1], self.client_id, self.timeout)
+            c = _Conn(addr[0], addr[1], self.client_id, self.timeout,
+                      self.security)
             with self._lock:
                 self._conns[addr] = c
             return c
@@ -743,13 +806,21 @@ class KafkaWireClient:
         failure against the stale cached leader address — not as an
         in-band NOT_LEADER reply. One metadata refresh then finds the
         new leader."""
+        import ssl as _ssl
+
         delay = 0.05
         for attempt in range(6):
             try:
                 return fn()
             except (KafkaProtocolError, OSError) as e:
-                retriable = (isinstance(e, OSError)
-                             or e.code in LEADER_RETRIABLE)
+                # TLS certificate failures are configuration errors, not
+                # elections — retrying them (over the same failing TLS
+                # bootstrap) just churns for seconds before surfacing.
+                retriable = ((isinstance(e, OSError)
+                              and not isinstance(
+                                  e, _ssl.SSLCertVerificationError))
+                             or (isinstance(e, KafkaProtocolError)
+                                 and e.code in LEADER_RETRIABLE))
                 if not retriable or attempt == 5:
                     raise
                 logger.warning(
@@ -802,7 +873,7 @@ class KafkaWireClient:
         w = Writer()
         try:
             conn = _Conn(self.bootstrap[0], self.bootstrap[1],
-                         self.client_id, self.timeout)
+                         self.client_id, self.timeout, self.security)
         except OSError:
             return None  # unreachable: let the real request surface it
         try:
@@ -1541,8 +1612,10 @@ class KafkaWireBroker:
                  message_format: str = "v1",
                  compression: Optional[str] = None,
                  idempotent: bool = False,
-                 isolation: str = "read_uncommitted") -> None:
-        self.client = KafkaWireClient(bootstrap, client_id)
+                 isolation: str = "read_uncommitted",
+                 security: Optional[dict] = None) -> None:
+        self.client = KafkaWireClient(bootstrap, client_id,
+                                      security=security)
         if idempotent and message_format != "v2":
             raise KafkaProtocolError(
                 "idempotent=True requires message_format='v2'")

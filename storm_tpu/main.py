@@ -124,7 +124,8 @@ def _make_broker(cfg: Config):
                                message_format=cfg.broker.message_format,
                                compression=cfg.broker.compression,
                                idempotent=cfg.broker.idempotent,
-                               isolation=cfg.broker.isolation)
+                               isolation=cfg.broker.isolation,
+                               security=cfg.broker.security_dict())
     raise ValueError(f"unknown broker kind {cfg.broker.kind!r}")
 
 

@@ -37,6 +37,16 @@ class KafkaStubBroker:
     #: "closed" = hang up on the probe like a pre-0.10 broker.
     api_versions: "dict | str | None" = None
 
+    #: SASL/PLAIN: set to ("user", "password") to require the 0.11-era
+    #: handshake (Kafka-framed SaslHandshake api 17, then RAW
+    #: length-prefixed tokens) before any other API on the connection;
+    #: wrong credentials close the socket like a real broker.
+    sasl: "tuple | None" = None
+
+    #: SSL: an ssl.SSLContext to wrap accepted connections with (combine
+    #: with ``sasl`` for SASL_SSL).
+    ssl_context = None
+
     #: True = REAL-broker transactional log semantics: transactional
     #: records append to the log immediately (tagged with their producer
     #: id) and EndTxn appends a control marker, occupying an offset —
@@ -132,6 +142,9 @@ class KafkaStubBroker:
 
     def _serve(self, conn: socket.socket, node: int = 0) -> None:
         try:
+            if self.ssl_context is not None:
+                conn = self.ssl_context.wrap_socket(conn, server_side=True)
+            authed = self.sasl is None
             while True:
                 head = self._recv(conn, 4)
                 if head is None:
@@ -145,6 +158,31 @@ class KafkaStubBroker:
                 api_version = r.i16()
                 corr = r.i32()
                 r.string()  # client id
+                if not authed:
+                    if api_key != 17:
+                        return  # real brokers drop pre-auth requests
+                    mech = r.string()
+                    w = Writer()
+                    w.i16(0 if mech == "PLAIN" else 33)  # UNSUPPORTED_SASL
+                    w.i32(1).string("PLAIN")
+                    resp = struct.pack(">i", corr) + bytes(w.buf)
+                    conn.sendall(struct.pack(">i", len(resp)) + resp)
+                    if mech != "PLAIN":
+                        return
+                    # raw (pre-KIP-152) token frame: \0user\0password
+                    tok_head = self._recv(conn, 4)
+                    if tok_head is None:
+                        return
+                    token = self._recv(conn, struct.unpack(
+                        ">i", tok_head)[0])
+                    parts = (token or b"").split(b"\x00")
+                    if (len(parts) != 3
+                            or parts[1].decode() != self.sasl[0]
+                            or parts[2].decode() != self.sasl[1]):
+                        return  # auth failure: close, like a real broker
+                    conn.sendall(struct.pack(">i", 0))  # empty server token
+                    authed = True
+                    continue
                 body = self._dispatch(api_key, api_version, r, node)
                 resp = struct.pack(">i", corr) + body
                 conn.sendall(struct.pack(">i", len(resp)) + resp)

@@ -1406,3 +1406,112 @@ def test_produce_survives_leader_broker_death():
     finally:
         client.close()
         stub.close()
+
+
+# ---- transport security (SASL/PLAIN + SSL) -----------------------------------
+
+
+def test_sasl_plain_round_trip():
+    """SASL_PLAINTEXT: the 0.11-era handshake (Kafka-framed SaslHandshake
+    api 17 + raw pre-KIP-152 token frames) authenticates every connection;
+    produce/fetch work over the authenticated socket."""
+    stub = KafkaStubBroker(partitions=1)
+    stub.sasl = ("alice", "s3cret")
+    sec = {"protocol": "SASL_PLAINTEXT", "sasl_username": "alice",
+           "sasl_password": "s3cret"}
+    client = KafkaWireClient(f"127.0.0.1:{stub.port}", security=sec)
+    try:
+        client.produce("t", 0, [(None, b"locked")])
+        recs = client.fetch("t", 0, 0, max_wait_ms=10)
+        assert [r.value for r in recs] == [b"locked"]
+    finally:
+        client.close()
+        stub.close()
+
+    # wrong password: the broker closes the connection -> loud failure
+    stub2 = KafkaStubBroker(partitions=1)
+    stub2.sasl = ("alice", "s3cret")
+    bad = KafkaWireClient(
+        f"127.0.0.1:{stub2.port}",
+        security={"protocol": "SASL_PLAINTEXT", "sasl_username": "alice",
+                  "sasl_password": "wrong"})
+    try:
+        with pytest.raises((KafkaProtocolError, OSError)):
+            bad.produce("t", 0, [(None, b"x")])
+    finally:
+        bad.close()
+        stub2.close()
+
+    # unauthenticated client against a SASL broker: dropped pre-auth
+    stub3 = KafkaStubBroker(partitions=1)
+    stub3.sasl = ("alice", "s3cret")
+    plain = KafkaWireClient(f"127.0.0.1:{stub3.port}")
+    try:
+        with pytest.raises((KafkaProtocolError, OSError)):
+            plain.produce("t", 0, [(None, b"x")])
+    finally:
+        plain.close()
+        stub3.close()
+
+
+@pytest.fixture(scope="module")
+def ssl_certs(tmp_path_factory):
+    import subprocess
+
+    d = tmp_path_factory.mktemp("certs")
+    crt, key = str(d / "broker.crt"), str(d / "broker.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", "2", "-subj",
+         "/CN=127.0.0.1", "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return crt, key
+
+
+def _ssl_server_context(ssl_certs):
+    import ssl
+
+    crt, key = ssl_certs
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(crt, key)
+    return ctx
+
+
+def test_ssl_round_trip(ssl_certs):
+    """SSL: every broker connection is TLS-wrapped; the broker's cert is
+    verified against the configured CA bundle."""
+    crt, _ = ssl_certs
+    stub = KafkaStubBroker(partitions=1)
+    stub.ssl_context = _ssl_server_context(ssl_certs)
+    client = KafkaWireClient(
+        f"127.0.0.1:{stub.port}",
+        security={"protocol": "SSL", "ssl_cafile": crt,
+                  "ssl_check_hostname": False})
+    try:
+        client.produce("t", 0, [(None, b"tls")])
+        assert [r.value for r in client.fetch("t", 0, 0, max_wait_ms=10)] \
+            == [b"tls"]
+    finally:
+        client.close()
+        stub.close()
+
+
+def test_sasl_ssl_round_trip(ssl_certs):
+    """SASL_SSL: TLS first, then SASL/PLAIN over the encrypted channel —
+    the full production transport stack of the 0.11 era."""
+    crt, _ = ssl_certs
+    stub = KafkaStubBroker(partitions=1)
+    stub.ssl_context = _ssl_server_context(ssl_certs)
+    stub.sasl = ("svc", "pw")
+    client = KafkaWireClient(
+        f"127.0.0.1:{stub.port}",
+        security={"protocol": "SASL_SSL", "sasl_username": "svc",
+                  "sasl_password": "pw", "ssl_cafile": crt,
+                  "ssl_check_hostname": False})
+    try:
+        client.produce("t", 0, [(None, b"both")])
+        assert [r.value for r in client.fetch("t", 0, 0, max_wait_ms=10)] \
+            == [b"both"]
+    finally:
+        client.close()
+        stub.close()
