@@ -1160,9 +1160,7 @@ def main() -> None:
         # throughput (args.repeats - 1) more times, INTERLEAVED at matrix
         # level so weather drift spreads across configs instead of biasing
         # one, and report min/median/max with the median as the headline.
-        singles = [(i, name, overrides)
-                   for i, (name, overrides) in enumerate(matrix)
-                   if name in CONFIGS and "error" not in results[i]]
+        singles = _repeatable_rows(matrix, results)
         if args.repeats > 1 and singles:
             samples = {i: [results[i]["value"]] for i, *_ in singles}
             for rep in range(1, args.repeats):
@@ -1202,6 +1200,18 @@ def main() -> None:
         return
     result = run_multi(args) if args.config == "multi" else run_single(args)
     print(json.dumps(result))
+
+
+def _repeatable_rows(matrix, results):
+    """--all rows eligible for interleaved throughput repeats: the
+    single-model configs run_single can re-measure. Excludes 'multi'
+    (a run_multi aggregate — run_single(config='multi') raises), the
+    autoscale / latency-breakdown demo rows (not in CONFIGS), and rows
+    whose first pass already failed."""
+    return [(i, name, overrides)
+            for i, (name, overrides) in enumerate(matrix)
+            if name in CONFIGS and name != "multi"
+            and "error" not in results[i]]
 
 
 def run_single(args) -> dict:

@@ -74,7 +74,8 @@ ERROR_NAMES = {
     14: "COORDINATOR_LOAD_IN_PROGRESS", 15: "COORDINATOR_NOT_AVAILABLE",
     16: "NOT_COORDINATOR", 22: "ILLEGAL_GENERATION",
     25: "UNKNOWN_MEMBER_ID", 27: "REBALANCE_IN_PROGRESS",
-    28: "INVALID_COMMIT_OFFSET_SIZE", 35: "UNSUPPORTED_VERSION",
+    28: "INVALID_COMMIT_OFFSET_SIZE", 33: "UNSUPPORTED_SASL_MECHANISM",
+    34: "ILLEGAL_SASL_STATE", 35: "UNSUPPORTED_VERSION",
     45: "OUT_OF_ORDER_SEQUENCE_NUMBER", 46: "DUPLICATE_SEQUENCE_NUMBER",
     47: "INVALID_PRODUCER_EPOCH", 48: "INVALID_TXN_STATE",
 }
@@ -545,23 +546,29 @@ class _Conn:
         self.lock = threading.Lock()
         self._corr = 0
         proto = (security or {}).get("protocol", "PLAINTEXT")
-        if proto in ("SSL", "SASL_SSL"):
-            import ssl as _ssl
+        try:
+            if proto in ("SSL", "SASL_SSL"):
+                import ssl as _ssl
 
-            cafile = security.get("ssl_cafile") or None
-            ctx = _ssl.create_default_context(cafile=cafile)
-            if not security.get("ssl_check_hostname", True):
-                # skips hostname/SAN matching ONLY; the chain is still
-                # verified against the CA bundle (or system CAs)
-                ctx.check_hostname = False
-            if not security.get("ssl_verify", True):
-                # explicit, separate opt-out: accept any cert (encryption
-                # without authentication — private-network last resort)
-                ctx.check_hostname = False
-                ctx.verify_mode = _ssl.CERT_NONE
-            self.sock = ctx.wrap_socket(self.sock, server_hostname=host)
-        if proto in ("SASL_PLAINTEXT", "SASL_SSL"):
-            self._sasl_plain(security)
+                cafile = security.get("ssl_cafile") or None
+                ctx = _ssl.create_default_context(cafile=cafile)
+                if not security.get("ssl_check_hostname", True):
+                    # skips hostname/SAN matching ONLY; the chain is
+                    # still verified against the CA bundle (or system CAs)
+                    ctx.check_hostname = False
+                if not security.get("ssl_verify", True):
+                    # explicit, separate opt-out: accept any cert
+                    # (encryption without authentication — last resort)
+                    ctx.check_hostname = False
+                    ctx.verify_mode = _ssl.CERT_NONE
+                self.sock = ctx.wrap_socket(self.sock, server_hostname=host)
+            if proto in ("SASL_PLAINTEXT", "SASL_SSL"):
+                self._sasl_plain(security)
+        except BaseException:
+            # a failed TLS/SASL step must not leak the connected socket
+            # (the retry loops would accumulate fds until GC)
+            self.close()
+            raise
 
     def _sasl_plain(self, security: dict) -> None:
         """0.10/0.11-era SASL/PLAIN: a Kafka-framed SaslHandshake (api 17
@@ -806,19 +813,20 @@ class KafkaWireClient:
         failure against the stale cached leader address — not as an
         in-band NOT_LEADER reply. One metadata refresh then finds the
         new leader."""
-        import ssl as _ssl
-
         delay = 0.05
         for attempt in range(6):
             try:
                 return fn()
             except (KafkaProtocolError, OSError) as e:
-                # TLS certificate failures are configuration errors, not
-                # elections — retrying them (over the same failing TLS
-                # bootstrap) just churns for seconds before surfacing.
+                # TLS failures (bad cert, TLS-to-PLAINTEXT-listener, ...)
+                # are configuration errors, not elections — retrying them
+                # over the same failing bootstrap just churns for seconds
+                # before surfacing. ssl is imported lazily here so
+                # PLAINTEXT deployments never load it.
+                import ssl as _ssl
+
                 retriable = ((isinstance(e, OSError)
-                              and not isinstance(
-                                  e, _ssl.SSLCertVerificationError))
+                              and not isinstance(e, _ssl.SSLError))
                              or (isinstance(e, KafkaProtocolError)
                                  and e.code in LEADER_RETRIABLE))
                 if not retriable or attempt == 5:

@@ -136,3 +136,16 @@ def test_offer_load_depth_guard_time_based_at_low_rates():
         backlog_fn=lambda sent: 12,  # 3s of work at 4 msg/s
         guard_checks=12, check_interval=0.05)
     assert aborted
+
+
+def test_repeatable_rows_selection():
+    """Interleaved-repeat eligibility (--all --repeats): single-model
+    configs only — 'multi' is a run_multi aggregate (run_single would
+    KeyError, the bug the first r04 capture hit), demo rows aren't
+    configs, and failed first passes don't repeat."""
+    matrix = [("lenet5", {}), ("resnet20", {"weights": "int8"}),
+              ("multi", {}), ("autoscale", {}), ("resnet50", {})]
+    results = [{"value": 1}, {"value": 2}, {"value": 3}, {"value": 4},
+               {"config": "resnet50", "error": "boom"}]
+    rows = bench._repeatable_rows(matrix, results)
+    assert [(i, n) for i, n, _ in rows] == [(0, "lenet5"), (1, "resnet20")]
