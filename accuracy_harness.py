@@ -161,7 +161,7 @@ def engine_accuracy(model_cfg, sharding_cfg, x_te, y_te):
 
 
 def e2e_run(model_cfg, sharding_cfg, x_te, y_te, engine_preds, mode,
-            timeout_s: float = 420.0):
+            timeout_s: float = 420.0, wire: bool = False):
     """Serve the test set through the full topology; returns the e2e row.
 
     One image per record on ONE partition with spout/infer/sink
@@ -186,31 +186,60 @@ def e2e_run(model_cfg, sharding_cfg, x_te, y_te, engine_preds, mode,
     cfg.topology.spout_parallelism = 1
     cfg.topology.inference_parallelism = 1
     cfg.topology.sink_parallelism = 1
+    # sync sends: async mode races concurrent produces (worker threads on
+    # a network broker), scrambling arrival order — the positional proof
+    # needs one in-order send at a time.
+    cfg.sink.mode = "sync"
     cfg.offsets.policy = "earliest"
     cfg.offsets.max_behind = None
 
-    broker = MemoryBroker(default_partitions=1)
+    if wire:
+        # --wire: the REAL Kafka wire protocol over sockets (stub broker)
+        # instead of the in-process MemoryBroker — proves the accuracy
+        # path through record-batch encode/decode + fetch/produce framing.
+        from tests.kafka_stub import KafkaStubBroker
+        from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+        stub = KafkaStubBroker(partitions=1)
+        broker = KafkaWireBroker(f"127.0.0.1:{stub.port}")
+    else:
+        stub = None
+        broker = MemoryBroker(default_partitions=1)
     n = len(x_te)
     topo = build_standard_topology(cfg, broker)
-    with LocalCluster() as cluster:
-        cluster.submit_topology("accuracy", cfg, topo)
-        t0 = time.time()
-        for img in x_te:
-            broker.produce(cfg.broker.input_topic, json.dumps(
-                {"instances": [img.tolist()]}), partition=0)
-        while time.time() - t0 < timeout_s:
-            if broker.topic_size(cfg.broker.output_topic) >= n:
-                break
-            time.sleep(0.25)
-        produced = broker.topic_size(cfg.broker.output_topic)
-        dead = broker.topic_size(cfg.broker.dead_letter_topic)
+    size_of = (stub.topic_size if stub is not None else broker.topic_size)
+    try:
+        with LocalCluster() as cluster:
+            cluster.submit_topology("accuracy", cfg, topo)
+            t0 = time.time()
+            for img in x_te:
+                broker.produce(cfg.broker.input_topic, json.dumps(
+                    {"instances": [img.tolist()]}), partition=0)
+            while time.time() - t0 < timeout_s:
+                if size_of(cfg.broker.output_topic) >= n:
+                    break
+                time.sleep(0.25)
+            produced = size_of(cfg.broker.output_topic)
+            dead = size_of(cfg.broker.dead_letter_topic)
 
-    if produced < n:
-        return {"error": f"only {produced}/{n} outputs after {timeout_s}s "
-                         f"({dead} dead-lettered)"}
-    recs = broker.fetch(cfg.broker.output_topic, 0, 0, max_records=n + 10)
-    outs = np.concatenate(
-        [decode_predictions(r.value).data for r in recs[:n]])
+        if produced < n:
+            return {"error": f"only {produced}/{n} outputs after "
+                             f"{timeout_s}s ({dead} dead-lettered)"}
+        recs = []
+        while len(recs) < n:  # brokers cap records per fetch; page through
+            batch = broker.fetch(cfg.broker.output_topic, 0, len(recs),
+                                 max_records=n - len(recs))
+            if not batch:
+                break
+            recs.extend(batch)
+        if len(recs) < n:
+            return {"error": f"fetch pages dried up at {len(recs)}/{n}"}
+        outs = np.concatenate(
+            [decode_predictions(r.value).data for r in recs[:n]])
+    finally:
+        if stub is not None:
+            broker.close()
+            stub.close()
 
     row_diff = np.abs(outs - engine_preds).max(axis=1)
     row_match = float((row_diff <= TRANSPORT_TOL[mode]).mean())
@@ -241,6 +270,10 @@ def main() -> int:
                          "virtual mesh (env vars alone are overridden by "
                          "the TPU plugin's sitecustomize); 'default' keeps "
                          "whatever jax.devices() resolves (the real chip)")
+    ap.add_argument("--wire", action="store_true",
+                    help="serve the e2e phase over the REAL Kafka wire "
+                         "protocol (socket stub broker) instead of the "
+                         "in-process MemoryBroker")
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -278,7 +311,8 @@ def main() -> int:
                    "acc_float_device": round(float_acc, 4),
                    "acc_engine_device": round(acc_eng, 4),
                    "epsilon": EPSILON[mode]}
-            row.update(e2e_run(mc, sc, x_te, y_te, engine_preds, mode))
+            row.update(e2e_run(mc, sc, x_te, y_te, engine_preds, mode,
+                               wire=args.wire))
             if "acc_e2e" in row:
                 row["pass"] = bool(
                     abs(row["acc_e2e"] - float_acc) <= row["epsilon"]
