@@ -858,7 +858,7 @@ class KafkaWireClient:
                     "%s: %s — re-finding coordinator (attempt %d)",
                     what, e, attempt + 1)
                 with self._lock:
-                    self._coordinators.pop(key, None)
+                    self._coordinators.pop(key, None)  # group or txn key
                 time.sleep(delay)
                 delay = min(1.0, delay * 2)
 
@@ -1354,6 +1354,12 @@ class KafkaWireClient:
             return self._request(
                 self._txn_coordinator_addr(txn_id), api, version, body)
 
+    def invalidate_coordinator(self, group: str) -> None:
+        """Drop the cached coordinator address (it moved / its broker
+        died); the next coordinator RPC re-discovers via FindCoordinator."""
+        with self._lock:
+            self._coordinators.pop(group, None)
+
     def _coordinator_request(
         self, group: str, api: int, version: int, body: bytes
     ) -> Reader:
@@ -1361,8 +1367,7 @@ class KafkaWireClient:
             return self._request(self._coordinator_addr(group), api, version, body)
         except (OSError, KafkaProtocolError):
             # Coordinator may have moved; re-discover once.
-            with self._lock:
-                self._coordinators.pop(group, None)
+            self.invalidate_coordinator(group)
             return self._request(self._coordinator_addr(group), api, version, body)
 
     def offset_commit(self, group: str, topic: str, partition: int, offset: int) -> None:
@@ -1500,9 +1505,11 @@ class GroupMembership:
 
     # v0 wire bodies ----------------------------------------------------------
 
-    def _coordinator(self):
-        # the stub (and a single-broker cluster) coordinates on bootstrap
-        return self.client.bootstrap
+    def _rpc(self, api: int, body: bytes) -> Reader:
+        """Membership RPC to the GROUP coordinator (FindCoordinator-cached,
+        re-discovered once on transport errors — a dead coordinator broker
+        must not wedge the member on a stale cached address)."""
+        return self.client._coordinator_request(self.group, api, 0, body)
 
     def join(self, max_attempts: int = 40) -> List[Tuple[str, int]]:
         for _ in range(max_attempts):
@@ -1512,7 +1519,7 @@ class GroupMembership:
             w.i32(1)
             w.string(self.PROTOCOL)
             w.bytes_(self._encode_subscription(self.topics))
-            r = self.client._request(self._coordinator(), 11, 0, bytes(w.buf))
+            r = self._rpc(11, bytes(w.buf))
             err = r.i16()
             if err:
                 # retryable coordination errors: evicted member (25 — rejoin
@@ -1520,6 +1527,8 @@ class GroupMembership:
                 # (27). Anything else is a real fault.
                 if err == 25:
                     self.member_id = ""
+                if err in COORD_RETRIABLE:
+                    self.client.invalidate_coordinator(self.group)
                 if err in (14, 15, 16, 25, 27):
                     time.sleep(0.05)
                     continue
@@ -1548,7 +1557,7 @@ class GroupMembership:
                 for mid, ablob in assignments.items():
                     w.string(mid)
                     w.bytes_(ablob)
-                r = self.client._request(self._coordinator(), 14, 0, bytes(w.buf))
+                r = self._rpc(14, bytes(w.buf))
                 err = r.i16()
                 blob = r.bytes_()
                 if err != 27:
@@ -1558,6 +1567,8 @@ class GroupMembership:
                 continue  # leader still absent after patience: rejoin
             if err:
                 self.member_id = self.member_id if err != 25 else ""
+                if err in COORD_RETRIABLE:
+                    self.client.invalidate_coordinator(self.group)
                 time.sleep(0.05)
                 continue
             return self._decode_assignment(blob or b"")
@@ -1592,18 +1603,38 @@ class GroupMembership:
                 for m, parts in per_member.items()}
 
     def heartbeat(self) -> bool:
-        """True = group stable; False = rebalance in progress (rejoin)."""
+        """True = group stable; False = rejoin needed (rebalance in
+        progress, member evicted, ...). A coordinator MOVE is handled
+        in place: re-find and retry the heartbeat once — member and
+        generation stay valid on the new coordinator (group state lives
+        in __consumer_offsets), so a routine broker roll must not force
+        a group-wide rebalance."""
         w = Writer()
         w.string(self.group).i32(self.generation).string(self.member_id)
-        r = self.client._request(self._coordinator(), 12, 0, bytes(w.buf))
-        return r.i16() == 0
+        body = bytes(w.buf)
+        err = self._rpc(12, body).i16()
+        if err in COORD_RETRIABLE:
+            self.client.invalidate_coordinator(self.group)
+            err = self._rpc(12, body).i16()
+        return err == 0
 
     def leave(self) -> None:
+        """Prompt exit (survivors rebalance immediately instead of waiting
+        out the session timeout) — so a leave answered NOT_COORDINATOR by
+        a stale cached address re-finds and retries; best-effort beyond
+        that (the session timeout is the backstop)."""
         if not self.member_id:
             return
         w = Writer()
         w.string(self.group).string(self.member_id)
-        self.client._request(self._coordinator(), 13, 0, bytes(w.buf))
+        body = bytes(w.buf)
+        try:
+            err = self._rpc(13, body).i16()
+            if err in COORD_RETRIABLE:
+                self.client.invalidate_coordinator(self.group)
+                self._rpc(13, body)
+        except (OSError, KafkaProtocolError):
+            pass  # best effort; session timeout reclaims the member
         self.member_id = ""
         self.generation = -1
 

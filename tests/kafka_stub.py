@@ -247,12 +247,21 @@ class KafkaStubBroker:
         if api == 9:
             return self._offset_fetch(r, err_override=16 if not_coord else 0)
         if api == 11:
+            if not_coord:  # JoinGroup v0 error shape
+                return bytes(Writer().i16(16).i32(-1).string("")
+                             .string("").string("").i32(0).buf)
             return self._join_group(r)
         if api == 14:
+            if not_coord:  # SyncGroup v0 error shape
+                return bytes(Writer().i16(16).bytes_(b"").buf)
             return self._sync_group(r)
         if api == 12:
+            if not_coord:
+                return bytes(Writer().i16(16).buf)
             return self._heartbeat(r)
         if api == 13:
+            if not_coord:
+                return bytes(Writer().i16(16).buf)
             return self._leave_group(r)
         if api == 22:
             return self._init_producer_id(r) if not not_coord \

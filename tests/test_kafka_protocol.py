@@ -1515,3 +1515,35 @@ def test_sasl_ssl_round_trip(ssl_certs):
     finally:
         client.close()
         stub.close()
+
+
+def test_group_membership_survives_coordinator_move():
+    """Consumer-group membership survives a coordinator migration IN
+    PLACE: the stale node answers NOT_COORDINATOR, the member re-finds
+    the coordinator and retries the heartbeat — member and generation
+    stay valid (group state lives in __consumer_offsets), so a routine
+    broker roll does NOT force a group-wide rebalance. Join after the
+    move also lands on the new coordinator."""
+    from storm_tpu.connectors.kafka_protocol import GroupMembership
+
+    stub = KafkaStubBroker(partitions=4, nodes=2)
+    client = KafkaWireClient(f"127.0.0.1:{stub.port}")
+    try:
+        m = GroupMembership(client, "mv-g", ["t"])
+        parts = m.join()
+        assert sorted(p for _, p in parts) == [0, 1, 2, 3]
+        assert m.heartbeat()
+
+        stub.move_coordinator(1)
+        # stale cached coordinator answers 16 -> re-find + retry in place
+        assert m.heartbeat() is True
+        # a later rejoin (e.g. after a REAL rebalance) finds node 1 too
+        parts2 = m.join()
+        assert sorted(p for _, p in parts2) == [0, 1, 2, 3]
+        assert m.heartbeat()
+
+        stub.move_coordinator(0)  # and back
+        assert m.heartbeat() is True
+    finally:
+        client.close()
+        stub.close()
