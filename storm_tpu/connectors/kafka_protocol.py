@@ -1078,6 +1078,16 @@ class KafkaWireClient:
                     err = r.i16()
                     base_offset = r.i64()
                     r.i64()  # log_append_time
+                    if err == 46:
+                        # DUPLICATE_SEQUENCE_NUMBER: the broker's
+                        # "already appended" answer to an idempotent
+                        # resend whose first attempt landed but whose
+                        # response was lost — SUCCESS (this duplicate
+                        # suppression is what idempotence exists for;
+                        # treating it as fatal would reset the producer
+                        # and re-produce under a fresh pid, creating the
+                        # very duplicate it prevented).
+                        continue
                     if err:
                         raise _proto_error("produce", err)
             r.i32()  # throttle
@@ -1511,6 +1521,20 @@ class GroupMembership:
         must not wedge the member on a stale cached address)."""
         return self.client._coordinator_request(self.group, api, 0, body)
 
+    def _rpc_err(self, api: int, body: bytes):
+        """(reader, None) or (None, code) when the coordinator LOOKUP
+        itself answers a retriable error (COORDINATOR_NOT_AVAILABLE on a
+        freshly started cluster, NOT_COORDINATOR mid-move) — the join
+        loop's in-band retry must also cover lookup-phase failures, or a
+        routine startup race escapes its 40-attempt patience."""
+        try:
+            return self._rpc(api, body), None
+        except KafkaProtocolError as e:
+            if e.code in COORD_RETRIABLE:
+                self.client.invalidate_coordinator(self.group)
+                return None, e.code
+            raise
+
     def join(self, max_attempts: int = 40) -> List[Tuple[str, int]]:
         for _ in range(max_attempts):
             w = Writer()
@@ -1519,7 +1543,10 @@ class GroupMembership:
             w.i32(1)
             w.string(self.PROTOCOL)
             w.bytes_(self._encode_subscription(self.topics))
-            r = self._rpc(11, bytes(w.buf))
+            r, lookup_err = self._rpc_err(11, bytes(w.buf))
+            if r is None:
+                time.sleep(0.05)
+                continue
             err = r.i16()
             if err:
                 # retryable coordination errors: evicted member (25 — rejoin
@@ -1557,7 +1584,11 @@ class GroupMembership:
                 for mid, ablob in assignments.items():
                     w.string(mid)
                     w.bytes_(ablob)
-                r = self._rpc(14, bytes(w.buf))
+                r, lookup_err = self._rpc_err(14, bytes(w.buf))
+                if r is None:
+                    err, blob = lookup_err, b""
+                    time.sleep(0.05)
+                    continue
                 err = r.i16()
                 blob = r.bytes_()
                 if err != 27:
@@ -1612,10 +1643,12 @@ class GroupMembership:
         w = Writer()
         w.string(self.group).i32(self.generation).string(self.member_id)
         body = bytes(w.buf)
-        err = self._rpc(12, body).i16()
+        r, _ = self._rpc_err(12, body)
+        err = r.i16() if r is not None else 16
         if err in COORD_RETRIABLE:
             self.client.invalidate_coordinator(self.group)
-            err = self._rpc(12, body).i16()
+            r, _ = self._rpc_err(12, body)
+            err = r.i16() if r is not None else 16
         return err == 0
 
     def leave(self) -> None:

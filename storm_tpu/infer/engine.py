@@ -404,6 +404,7 @@ class InferenceEngine:
             with self._lock:
                 xd = jax.device_put(xw, self._x_sharding)
                 out = self._fwd_q(self.params, self.state, xd, scale, offset)
+                gathered = self._gather_locked(out)
         else:
             # Cast on the HOST (ml_dtypes gives numpy a bfloat16) so the
             # host->device transfer ships half the bytes — the tunnel/PCIe
@@ -413,12 +414,31 @@ class InferenceEngine:
             with self._lock:
                 xd = jax.device_put(x, self._x_sharding)
                 out = self._fwd(self.params, self.state, xd)
+                gathered = self._gather_locked(out)
         self.compiled_batches.add(padded)
-        if self._multiprocess:
-            from jax.experimental import multihost_utils
+        if gathered is None:
+            # single-process: the host fetch happens OUTSIDE the lock so
+            # one batch's device->host RTT doesn't serialize the next
+            # batch's dispatch (max_inflight pipelining)
+            gathered = np.asarray(out)
+        return gathered[:n]
 
-            return multihost_utils.process_allgather(out, tiled=True)[:n]
-        return np.asarray(out)[:n]
+    def _gather_locked(self, out) -> "Optional[np.ndarray]":
+        """Multi-process results fetch — a cross-process COLLECTIVE
+        (process_allgather), so it must stay under the dispatch lock:
+        every process has to issue its device_put/forward/gather sequence
+        in one consistent order, and the lock serializes this process's
+        side of that contract. The other half is the caller's: in
+        multi-process serving every process feeds identical batches in
+        identical order (one operator task per process — see
+        tests/mh_serve_worker.py; concurrent tasks could still interleave
+        lock ACQUISITION differently across processes). Returns None in
+        single-process mode (fetch happens outside the lock)."""
+        if not self._multiprocess:
+            return None
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(out, tiled=True)
 
 
 # ---- engine sharing across operator tasks ------------------------------------

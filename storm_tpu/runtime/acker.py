@@ -35,6 +35,16 @@ class _Entry:
     # ``outstanding``). Only maintained by anchor/ack_edge; the legacy
     # ``xor`` entry point can't tell an emit from an ack and leaves it.
     live: int = 0
+    # Anchored-but-unacked edge ids, plus acks that ARRIVED BEFORE their
+    # anchor: in dist topologies the anchor travels from the emitting
+    # worker and the ack from the consuming worker over independent
+    # links, so the owner can see them out of order. Pairing them here
+    # keeps ``live`` exact and completion correct under any interleaving
+    # — without it a transient dip could fake tree closure for the EOS
+    # sink (committing offsets past unproduced siblings) or fake tree
+    # death (spurious replays).
+    edges: set = field(default_factory=set)
+    early_acks: set = field(default_factory=set)
     watchers: List[Callable[[bool], None]] = field(default_factory=list)
 
 
@@ -85,6 +95,12 @@ class AckLedger:
         """A new live edge was delivered under this root (emit event)."""
         e = self._entries.get(root_id)
         if e is not None:
+            if edge_id in e.early_acks:
+                # its ack overtook it on another link: cancel the pair —
+                # net zero live edges, net zero XOR
+                e.early_acks.discard(edge_id)
+                return
+            e.edges.add(edge_id)
             e.live += 1
         self.xor(root_id, edge_id)
 
@@ -92,6 +108,12 @@ class AckLedger:
         """A live edge was consumed (ack event)."""
         e = self._entries.get(root_id)
         if e is not None:
+            if edge_id not in e.edges:
+                # ack before its anchor (independent dist links): park it;
+                # the anchor cancels against it, counts never dip
+                e.early_acks.add(edge_id)
+                return
+            e.edges.discard(edge_id)
             e.live -= 1
         self.xor(root_id, edge_id)
 

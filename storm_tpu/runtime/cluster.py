@@ -483,28 +483,43 @@ class TopologyRuntime:
         proto = self.topology.specs[component_id].obj
         if component_id in self.bolt_execs:
             execs = self.bolt_execs[component_id]
-            while len(execs) < parallelism:
-                clone = clone_component(proto)
-                # Warm scale-up (VERDICT r3 weak #3): build/warm the
-                # replica's expensive state (engine compile, checkpoint
-                # load) on a worker thread BEFORE it joins the routing
-                # table — a cold prepare on the event loop would stall
-                # every executor in the process, and a cold replica
-                # fielding live traffic injects its compile time into the
-                # latency the scale-up exists to reduce.
-                prewarm = getattr(clone, "prewarm", None)
-                if prewarm is not None:
-                    await asyncio.to_thread(prewarm)
-                e = BoltExecutor(
-                    self,
-                    component_id,
-                    len(execs),
-                    clone,
-                    tcfg.inbox_capacity,
-                    tcfg.tick_interval_s,
-                )
-                execs.append(e)
-                e.start()
+            added: list = []
+            try:
+                while len(execs) < parallelism:
+                    clone = clone_component(proto)
+                    # Warm scale-up (VERDICT r3 weak #3): build/warm the
+                    # replica's expensive state (engine compile, checkpoint
+                    # load) on a worker thread BEFORE it joins the routing
+                    # table — a cold prepare on the event loop would stall
+                    # every executor in the process, and a cold replica
+                    # fielding live traffic injects its compile time into
+                    # the latency the scale-up exists to reduce.
+                    prewarm = getattr(clone, "prewarm", None)
+                    if prewarm is not None:
+                        await asyncio.to_thread(prewarm)
+                    e = BoltExecutor(
+                        self,
+                        component_id,
+                        len(execs),
+                        clone,
+                        tcfg.inbox_capacity,
+                        tcfg.tick_interval_s,
+                    )
+                    # append before start so prepare() sees the grown
+                    # parallelism (parallelism_of == len(execs) — the EOS
+                    # sink's parallelism-1 guard depends on it)...
+                    execs.append(e)
+                    added.append(e)
+                    e.start()
+            except BaseException:
+                # ...and a prepare() raise rolls back EVERY executor this
+                # call added — a half-registered, never-started executor
+                # left in bolt_execs would swallow routed tuples forever.
+                for e in reversed(added):
+                    if e in execs:
+                        execs.remove(e)
+                    await e.stop(drain=False)
+                raise
             removed = []
             while len(execs) > parallelism:
                 removed.append(execs.pop())

@@ -37,6 +37,10 @@ class KafkaStubBroker:
     #: "closed" = hang up on the probe like a pre-0.10 broker.
     api_versions: "dict | str | None" = None
 
+    #: answer idempotent duplicates with DUPLICATE_SEQUENCE_NUMBER (46)
+    #: instead of silently acking with the original offset
+    duplicate_error = False
+
     #: SASL/PLAIN: set to ("user", "password") to require the 0.11-era
     #: handshake (Kafka-framed SaslHandshake api 17, then RAW
     #: length-prefixed tokens) before any other API on the connection;
@@ -573,6 +577,12 @@ class KafkaStubBroker:
                         if last is not None and base_seq == last[0]:
                             # exact duplicate of the last batch: already
                             # appended; ack with the original base offset
+                            # (or, in duplicate_error mode, answer the
+                            # explicit DUPLICATE_SEQUENCE_NUMBER code some
+                            # 0.11-era paths return — the client must
+                            # treat BOTH as success)
+                            if self.duplicate_error:
+                                err = 46
                             base = last[2]
                             data = b""
                         elif base_seq != expected:

@@ -900,3 +900,42 @@ def test_txn_small_chunk_warns(caplog):
                                        max_behind=None), chunk=64)
         s2.open(Ctx(), Coll())
     assert not [r for r in caplog.records if r.name == "storm_tpu.spout"]
+
+
+def test_eos_rebalance_to_parallel_sink_rolls_back(run):
+    """Growing the offsets-committing sink past parallelism 1 must fail
+    loudly AND leave the runtime intact: the rejected replica is rolled
+    out of bolt_execs (a half-registered executor would swallow routed
+    tuples forever) and the pipeline keeps flowing."""
+    from storm_tpu.connectors import TransactionalBrokerSink
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    async def main():
+        broker = MemoryBroker(default_partitions=2)
+        for i in range(3):
+            broker.produce("in", f"a{i}", partition=i % 2)
+        tb = TopologyBuilder()
+        tb.set_spout("s", BrokerSpout(
+            broker, "in",
+            OffsetsConfig(policy="txn", group_id="rb-g",
+                          max_behind=None)), 1)
+        tb.set_bolt("sink", TransactionalBrokerSink(
+            broker, "out",
+            SinkConfig(mode="transactional", txn_batch=2, txn_ms=20.0,
+                       offsets_group="rb-g")), 1).shuffle_grouping("s")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("rb", Config(), tb.build())
+        with pytest.raises(ValueError, match="parallelism 1"):
+            await rt.rebalance("sink", 2)
+        assert rt.parallelism_of("sink") == 1  # rolled back, not zombie
+        for i in range(3, 6):
+            broker.produce("in", f"a{i}", partition=i % 2)
+        deadline = asyncio.get_event_loop().time() + 20
+        while asyncio.get_event_loop().time() < deadline:
+            if broker.topic_size("out") >= 6:
+                break
+            await asyncio.sleep(0.05)
+        assert broker.topic_size("out") == 6  # still flowing after the raise
+        await cluster.shutdown()
+
+    run(main(), timeout=40)

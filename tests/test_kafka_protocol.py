@@ -1547,3 +1547,26 @@ def test_group_membership_survives_coordinator_move():
     finally:
         client.close()
         stub.close()
+
+
+def test_idempotent_duplicate_sequence_reply_is_success():
+    """A broker answering an idempotent resend with
+    DUPLICATE_SEQUENCE_NUMBER (46) is saying 'already appended' — the
+    client must treat it as success, not reset the producer and
+    re-produce under a fresh pid (which would create the duplicate
+    idempotence exists to prevent)."""
+    stub = KafkaStubBroker(partitions=1)
+    stub.duplicate_error = True
+    client = KafkaWireClient(f"127.0.0.1:{stub.port}")
+    try:
+        pid, epoch = client.init_producer_id()
+        client.produce("t", 0, [(None, b"once")],
+                       message_format="v2", producer=(pid, epoch, 0))
+        # resend of the same sequence (lost-response retry): broker says 46
+        client.produce("t", 0, [(None, b"once")],
+                       message_format="v2", producer=(pid, epoch, 0))
+        recs = client.fetch("t", 0, 0, max_wait_ms=10)
+        assert [r.value for r in recs] == [b"once"]  # exactly one copy
+    finally:
+        client.close()
+        stub.close()

@@ -505,3 +505,30 @@ def test_ledger_live_edge_refcount_and_watch():
     led.fail_root(r2)
     assert fates2 == [False]
     assert led.outstanding(r2) == 0
+
+
+def test_ledger_tolerates_ack_before_anchor():
+    """In dist topologies an edge's anchor (from the emitting worker) and
+    ack (from the consuming worker) reach the root's owner over
+    INDEPENDENT links and can arrive in either order. The refcount must
+    never transiently dip — a dip could fake tree closure for the EOS
+    sink (offsets committed past unproduced siblings) or fake tree death
+    (spurious replays). Early acks park and cancel against their anchor."""
+    done = []
+    led = AckLedger(timeout_s=0)
+    root = new_id()
+    led.init_root(root, "m", lambda *a: done.append(a), 0.0)
+    e_spout, e_fast, e_slow = new_id(), new_id(), new_id()
+    led.anchor(root, e_spout)   # spout -> splitter delivery
+    led.anchor(root, e_fast)    # splitter -> sink (fast link)
+    # SLOW LINK: e_slow's anchor is delayed; its ack arrives first
+    led.ack_edge(root, e_slow)
+    assert led.outstanding(root) == 2  # no dip: parked, not subtracted
+    led.ack_edge(root, e_spout)
+    assert led.outstanding(root) == 1  # the sink's held tuple, correctly
+    led.anchor(root, e_slow)    # delayed anchor lands: cancels the pair
+    assert led.outstanding(root) == 1
+    assert not done              # tree still open
+    led.ack_edge(root, e_fast)
+    assert led.outstanding(root) == 0
+    assert done and done[0][1] is True  # completed exactly once
