@@ -308,6 +308,15 @@ class TransactionalBrokerSink(BrokerSink):
         # watches that clean up parked tuples of failed trees.
         self._parked: list = []
         self._watched: set = set()
+        self._live_watched: set = set()
+        # root -> count of held tuples (buf + parked) anchored to it:
+        # O(1) closure checks on the ack hot path (incremented on append,
+        # rebuilt from the survivors at each flush — the flush is the one
+        # place tuples leave in bulk, so rebuilding there absorbs every
+        # drop path without per-path decrement bookkeeping)
+        self._held_roots: dict = {}
+        self._closure_kick = False
+        self._kick_task: Optional[asyncio.Task] = None
         self._warned_unknown_tree = False
 
     async def execute(self, t: Tuple) -> None:
@@ -323,10 +332,52 @@ class TransactionalBrokerSink(BrokerSink):
             self.collector.ack(t)
             return
         self._buf.append((t, topic, key, value))
+        if self._offsets_group and t.anchors:
+            for r in t.anchors:
+                self._held_roots[r] = self._held_roots.get(r, 0) + 1
+        if self._offsets_group and t.origins and t.anchors:
+            # Tree-closure trigger: commit a held tree the moment its
+            # last non-sink edge settles instead of waiting out the txn
+            # deadline — without this, small spout entries (chunk x
+            # partitions < txn_batch) pay the full txn_ms per gated
+            # entry cycle (measured: chunk=1 ran at ~60 rec/s on a
+            # 50 ms deadline). Two halves: (a) closure may ALREADY hold
+            # at arrival (the bolt acked its input before this output
+            # reached us) -> check now and flush; (b) closure may happen
+            # later (an upstream branch still live) -> a ledger
+            # live-watch re-checks on every ack of the tree.
+            ledger = getattr(self.collector, "ledger", None)
+            if ledger is not None:
+                for r in t.anchors:
+                    if r not in self._live_watched and ledger.watch_live(
+                            r, self._on_live_edge_settled):
+                        self._live_watched.add(r)
+                if all(ledger.outstanding(r) == self._held_count(r)
+                       for r in t.anchors):
+                    await self._flush_txn()
+                    return
         if len(self._buf) >= self.txn_batch:
             await self._flush_txn()
         else:
             self._rearm_deadline()
+
+    def _held_count(self, root: int) -> int:
+        return self._held_roots.get(root, 0)
+
+    def _rebuild_held(self) -> None:
+        """Recount held tuples per root from the survivors (buf + parked)
+        — called after each flush, the one place tuples leave in bulk;
+        also prunes _live_watched ids whose tuples are all gone (root ids
+        are unique per tree instance, so gone means settled forever)."""
+        held: dict = {}
+        for item in self._buf:
+            for r in item[0].anchors:
+                held[r] = held.get(r, 0) + 1
+        for item in self._parked:
+            for r in item[0].anchors:
+                held[r] = held.get(r, 0) + 1
+        self._held_roots = held
+        self._live_watched &= set(held)
 
     async def _deadline_flush(self) -> None:
         await asyncio.sleep(self.txn_ms / 1e3)
@@ -334,6 +385,40 @@ class TransactionalBrokerSink(BrokerSink):
 
     async def flush(self) -> None:  # drain hook
         await self._flush_txn()
+
+    def _on_live_edge_settled(self, root: int) -> None:
+        """Ledger live-watch callback (on the loop): an edge of a held
+        tree was acked — if every remaining live edge of ``root`` is now
+        in our hands, the tree is closed and a flush commits it without
+        waiting for txn_batch/txn_ms. Debounced to one pending kick; the
+        kick re-scans after its flush so a closure that landed MID-flush
+        (and bounced off the debounce) is picked up rather than regressing
+        to the deadline."""
+        if self._closure_kick:
+            return
+        ledger = getattr(self.collector, "ledger", None)
+        if ledger is None:
+            return
+        held = self._held_count(root)
+        if held and ledger.outstanding(root) == held:
+            self._closure_kick = True
+
+            async def kick():
+                try:
+                    while True:
+                        await self._flush_txn()
+                        if not self._any_closed_held(ledger):
+                            break
+                finally:
+                    self._closure_kick = False
+
+            # strong ref: asyncio keeps tasks weakly; an unreferenced
+            # kick could be GC'd before running
+            self._kick_task = asyncio.get_running_loop().create_task(kick())
+
+    def _any_closed_held(self, ledger) -> bool:
+        return any(c and ledger.outstanding(r) == c
+                   for r, c in self._held_roots.items())
 
     def _on_tree_done(self, root: int, ok: bool) -> None:
         """Ledger watch callback for a parked root (fires on the loop).
@@ -358,6 +443,8 @@ class TransactionalBrokerSink(BrokerSink):
                             if root not in item[0].anchors]
             for item in drop:
                 self.collector.fail(item[0])
+            if drop:
+                self._rebuild_held()
 
     def _plan(self, held: list, n_prev: int = 0):
         """Split held tuples into (flush_now, park) and fold the offsets
@@ -504,6 +591,12 @@ class TransactionalBrokerSink(BrokerSink):
                 self._m_commits.inc()
                 for t, *_ in batch:
                     self._ack_delivered(t)
+            # Root-id bookkeeping: recount held tuples per root from the
+            # survivors (covers every leave path — committed, failed, and
+            # the dead-tree drops inside _plan) and prune stale
+            # live-watch ids.
+            if self._offsets_group:
+                self._rebuild_held()
             # Re-arm the deadline for tuples that arrived while this flush
             # held the lock, AND for parked tuples (their trees close when
             # upstream acks land, so the poll is what re-plans them) — on

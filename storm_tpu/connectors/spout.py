@@ -128,17 +128,20 @@ class BrokerSpout(Spout):
         # Streams' per-partition processing model; cross-partition
         # parallelism and chunking carry the throughput.
         self._txn_mode = cfg.policy == "txn"
-        if self._txn_mode and max(1, self.chunk) < 64:
-            # Measured cliff, not a guess: exactly-once delivery is ordered
-            # depth-1 per partition, so throughput rides entirely on entry
-            # size — chunk >= 64 benched FREE vs at-least-once while
-            # chunk=16 cost ~5x (BENCH_NOTES.md "what does exactly-once
-            # cost"). Loud because the default chunk silently hits it.
+        if self._txn_mode and max(1, self.chunk) < 16:
+            # Measured, not a guess: exactly-once delivery is ordered
+            # depth-1 per partition, so each entry pays a commit+ack
+            # round trip. The sink's tree-closure trigger commits a held
+            # entry the moment it closes (no txn_ms deadline wait), which
+            # keeps the cost bounded — measured ~4x at chunk=1, ~1.6x at
+            # chunk=4, FREE at chunk >= 16 (4 partitions, txn_batch 64;
+            # BENCH_NOTES.md "what does exactly-once cost").
             log.warning(
                 "offsets.policy='txn' with spout chunk %d: exactly-once "
-                "delivers one entry per partition at a time, and entries "
-                "this small cost ~5x throughput (measured; free at chunk "
-                ">= 64). Set topology.spout_chunk >= 64 — see "
+                "delivers one gated entry per partition at a time; "
+                "entries this small cost ~1.6-4x throughput (measured; "
+                "free at chunk >= txn_batch/partitions, typically 16). "
+                "Raise topology.spout_chunk — see "
                 "docs/OPERATIONS.md#exactly-once.", max(1, self.chunk))
         self._part_inflight: Dict[int, int] = {}
         for p in self.my_partitions:

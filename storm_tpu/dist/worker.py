@@ -223,6 +223,11 @@ class DistLedger:
             return self._base.watch(root_id, cb)
         return False
 
+    def watch_live(self, root_id: int, cb) -> bool:
+        if owner_of(root_id) == self._idx:
+            return self._base.watch_live(root_id, cb)
+        return False
+
     def fail_root(self, root_id: int) -> None:
         owner = owner_of(root_id)
         if owner == self._idx or owner not in self._senders:

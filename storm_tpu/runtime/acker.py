@@ -46,6 +46,11 @@ class _Entry:
     edges: set = field(default_factory=set)
     early_acks: set = field(default_factory=set)
     watchers: List[Callable[[bool], None]] = field(default_factory=list)
+    # fired (with the root id) after every live-count DECREASE while the
+    # entry is open — the EOS sink's tree-closure trigger (flush the
+    # moment the last non-sink edge settles instead of waiting out the
+    # txn deadline). Die with the entry.
+    live_watchers: List[Callable[[int], None]] = field(default_factory=list)
 
 
 class AckLedger:
@@ -115,7 +120,22 @@ class AckLedger:
                 return
             e.edges.discard(edge_id)
             e.live -= 1
+            watchers = list(e.live_watchers)
+        else:
+            watchers = []
         self.xor(root_id, edge_id)
+        for w in watchers:
+            w(root_id)
+
+    def watch_live(self, root_id: int, cb: Callable[[int], None]) -> bool:
+        """Register ``cb(root_id)`` to fire after every live-edge DECREASE
+        on this root while it is open. Returns False if the root is
+        already gone. Watchers die with the entry (no unregistration)."""
+        e = self._entries.get(root_id)
+        if e is None:
+            return False
+        e.live_watchers.append(cb)
+        return True
 
     def outstanding(self, root_id: int) -> int:
         """Exact count of live (delivered, unacked) edges for this root.

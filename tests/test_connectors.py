@@ -886,18 +886,18 @@ def test_txn_small_chunk_warns(caplog):
     with caplog.at_level(logging.WARNING, logger="storm_tpu.spout"):
         s = BrokerSpout(broker, "in",
                         OffsetsConfig(policy="txn", group_id="g",
-                                      max_behind=None), chunk=16)
+                                      max_behind=None), chunk=4)
         s.open(Ctx(), Coll())
-    assert any("5x" in r.message and "spout_chunk" in r.message
+    assert any("spout_chunk" in r.message and "gated entry" in r.message
                for r in caplog.records), caplog.records
 
-    # at or past the cliff: silent (on the spout's own logger — caplog
-    # collects every logger's records, so filter before asserting quiet)
+    # at or past the measured-free point: silent (on the spout's own
+    # logger — caplog collects every logger's records, filter first)
     caplog.clear()
     with caplog.at_level(logging.WARNING, logger="storm_tpu.spout"):
         s2 = BrokerSpout(broker, "in2",
                          OffsetsConfig(policy="txn", group_id="g",
-                                       max_behind=None), chunk=64)
+                                       max_behind=None), chunk=16)
         s2.open(Ctx(), Coll())
     assert not [r for r in caplog.records if r.name == "storm_tpu.spout"]
 
@@ -937,5 +937,48 @@ def test_eos_rebalance_to_parallel_sink_rolls_back(run):
             await asyncio.sleep(0.05)
         assert broker.topic_size("out") == 6  # still flowing after the raise
         await cluster.shutdown()
+
+    run(main(), timeout=40)
+
+
+def test_eos_tree_closure_commits_without_deadline_wait(run):
+    """The tree-closure trigger: an entry whose tree is fully held must
+    commit IMMEDIATELY, not after txn_ms/txn_batch — with a 30 s deadline
+    and a huge batch, three single-record entries still flow in well
+    under a second each (before the trigger, each gated entry waited the
+    full deadline: measured 60 rec/s at chunk=1 on a 50 ms txn_ms)."""
+    from storm_tpu.connectors import TransactionalBrokerSink
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+    from tests.test_runtime import PassBolt
+
+    async def main():
+        broker = MemoryBroker(default_partitions=1)
+        for i in range(3):
+            broker.produce("in", f"m{i}", partition=0)
+        tb = TopologyBuilder()
+        tb.set_spout("s", BrokerSpout(
+            broker, "in",
+            OffsetsConfig(policy="txn", group_id="cl-g",
+                          max_behind=None)), 1)
+        tb.set_bolt("mid", PassBolt(), 1).shuffle_grouping("s")
+        # deadline and batch far beyond the test timeout: only the
+        # closure trigger can commit these
+        tb.set_bolt("sink", TransactionalBrokerSink(
+            broker, "out",
+            SinkConfig(mode="transactional", txn_batch=512,
+                       txn_ms=30_000.0, offsets_group="cl-g")),
+            1).shuffle_grouping("mid")
+        cluster = AsyncLocalCluster()
+        await cluster.submit("closure", Config(), tb.build())
+        t0 = asyncio.get_event_loop().time()
+        while asyncio.get_event_loop().time() - t0 < 10:
+            if broker.topic_size("out") >= 3:
+                break
+            await asyncio.sleep(0.05)
+        took = asyncio.get_event_loop().time() - t0
+        await cluster.shutdown()
+        assert broker.topic_size("out") == 3, broker.topic_size("out")
+        assert took < 5.0, f"closure trigger too slow: {took:.1f}s"
+        assert broker.committed("cl-g", "in", 0) == 3
 
     run(main(), timeout=40)
