@@ -364,18 +364,24 @@ class TransactionalBrokerSink(BrokerSink):
     def _held_count(self, root: int) -> int:
         return self._held_roots.get(root, 0)
 
+    @staticmethod
+    def _count_roots(items, into: Optional[dict] = None) -> dict:
+        """Held-tuple count per anchor root — THE closure predicate's
+        denominator; _plan's by_root and _rebuild_held must agree on it
+        or the kick loop and the parking fixpoint diverge."""
+        held: dict = {} if into is None else into
+        for item in items:
+            for r in item[0].anchors:
+                held[r] = held.get(r, 0) + 1
+        return held
+
     def _rebuild_held(self) -> None:
         """Recount held tuples per root from the survivors (buf + parked)
         — called after each flush, the one place tuples leave in bulk;
         also prunes _live_watched ids whose tuples are all gone (root ids
         are unique per tree instance, so gone means settled forever)."""
-        held: dict = {}
-        for item in self._buf:
-            for r in item[0].anchors:
-                held[r] = held.get(r, 0) + 1
-        for item in self._parked:
-            for r in item[0].anchors:
-                held[r] = held.get(r, 0) + 1
+        held = self._count_roots(self._buf)
+        self._count_roots(self._parked, into=held)
         self._held_roots = held
         self._live_watched &= set(held)
 
@@ -406,8 +412,19 @@ class TransactionalBrokerSink(BrokerSink):
             async def kick():
                 try:
                     while True:
+                        before = len(self._buf) + len(self._parked)
                         await self._flush_txn()
-                        if not self._any_closed_held(ledger):
+                        # always yield, and stop when a flush made no
+                        # progress: a closed root BRIDGED to an open one
+                        # through a joint tuple parks everything (_plan's
+                        # fixpoint), and looping on it would busy-spin —
+                        # the open root's eventual ack fires a fresh kick,
+                        # and the deadline poll is the backstop.
+                        await asyncio.sleep(0)
+                        made_progress = (len(self._buf)
+                                         + len(self._parked)) < before
+                        if not made_progress \
+                                or not self._any_closed_held(ledger):
                             break
                 finally:
                     self._closure_kick = False
@@ -419,6 +436,20 @@ class TransactionalBrokerSink(BrokerSink):
     def _any_closed_held(self, ledger) -> bool:
         return any(c and ledger.outstanding(r) == c
                    for r, c in self._held_roots.items())
+
+    def _maybe_kick_closure(self) -> None:
+        """Post-flush re-check for deadline/batch flushes: an upstream ack
+        landing DURING the flush was evaluated against the pre-flush held
+        counts and then dropped — if a held tree is closed now (counts
+        just rebuilt), kick rather than regress it to the deadline."""
+        if self._closure_kick:
+            return
+        ledger = getattr(self.collector, "ledger", None)
+        if ledger is not None and self._any_closed_held(ledger):
+            for r, c in self._held_roots.items():
+                if c and ledger.outstanding(r) == c:
+                    self._on_live_edge_settled(r)
+                    return
 
     def _on_tree_done(self, root: int, ok: bool) -> None:
         """Ledger watch callback for a parked root (fires on the loop).
@@ -459,10 +490,7 @@ class TransactionalBrokerSink(BrokerSink):
         flushed tree ever leaves a sibling output behind.
         """
         ledger = getattr(self.collector, "ledger", None)
-        by_root: dict = {}
-        for t, *_ in held:
-            for r in t.anchors:
-                by_root[r] = by_root.get(r, 0) + 1
+        by_root = self._count_roots(held)
 
         open_roots: set = set()
         dead_roots: set = set()
@@ -555,6 +583,9 @@ class TransactionalBrokerSink(BrokerSink):
             if self._offsets_group:
                 batch, self._parked, offs = self._plan(held, n_prev)
                 if not batch:
+                    # _plan may have DROPPED dead-tree tuples even with
+                    # nothing to commit — the held counts must reflect it
+                    self._rebuild_held()
                     self._rearm_deadline()  # poll until the trees close
                     return
             else:
@@ -606,6 +637,10 @@ class TransactionalBrokerSink(BrokerSink):
             # then double-commit after replay).
             if self._buf or self._parked:
                 self._rearm_deadline()
+        # Outside the lock: closures that landed mid-flush were judged
+        # against pre-flush counts — re-check against the rebuilt ones.
+        if self._offsets_group:
+            self._maybe_kick_closure()
 
     def _rearm_deadline(self) -> None:
         # NB: when the current flush was triggered by the deadline task,
@@ -623,4 +658,8 @@ class TransactionalBrokerSink(BrokerSink):
     def cleanup(self) -> None:
         if self._deadline_task is not None:
             self._deadline_task.cancel()
+        if self._kick_task is not None:
+            # same hazard class as the deadline task: a pending closure
+            # kick must not run _flush_txn against a closed producer
+            self._kick_task.cancel()
         super().cleanup()

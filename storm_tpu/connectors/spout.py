@@ -134,8 +134,12 @@ class BrokerSpout(Spout):
             # round trip. The sink's tree-closure trigger commits a held
             # entry the moment it closes (no txn_ms deadline wait), which
             # keeps the cost bounded — measured ~4x at chunk=1, ~1.6x at
-            # chunk=4, FREE at chunk >= 16 (4 partitions, txn_batch 64;
-            # BENCH_NOTES.md "what does exactly-once cost").
+            # chunk=4, FREE at chunk >= 16 (BENCH_NOTES.md "what does
+            # exactly-once cost"). The 16 gate assumes the benched shape
+            # (4 partitions, txn_batch 64); the true free point is
+            # chunk >= txn_batch/partitions, which the spout cannot
+            # compute (txn_batch lives on the sink) — hence a fixed,
+            # bench-calibrated threshold and the formula in the message.
             log.warning(
                 "offsets.policy='txn' with spout chunk %d: exactly-once "
                 "delivers one gated entry per partition at a time; "
