@@ -445,11 +445,12 @@ class TransactionalBrokerSink(BrokerSink):
         if self._closure_kick:
             return
         ledger = getattr(self.collector, "ledger", None)
-        if ledger is not None and self._any_closed_held(ledger):
-            for r, c in self._held_roots.items():
-                if c and ledger.outstanding(r) == c:
-                    self._on_live_edge_settled(r)
-                    return
+        if ledger is None:
+            return
+        for r, c in self._held_roots.items():
+            if c and ledger.outstanding(r) == c:
+                self._on_live_edge_settled(r)
+                return
 
     def _on_tree_done(self, root: int, ok: bool) -> None:
         """Ledger watch callback for a parked root (fires on the loop).
