@@ -238,7 +238,7 @@ def build_topology(cfg, broker, batch_cfg, transfer_dtype=None, chunk=0, weights
     tb.set_spout(
         "kafka-spout",
         BrokerSpout(broker, "input", OffsetsConfig(policy="earliest", max_behind=None),
-                    fetch_size=1024, chunk=chunk),
+                    fetch_size=1024, chunk=chunk, scheme="raw"),
         parallelism=2,
     )
     tb.set_bolt(
@@ -1186,7 +1186,12 @@ def main() -> None:
         # one, and report min/median/max with the median as the headline.
         singles = _repeatable_rows(matrix, results)
         if args.repeats > 1 and singles:
-            samples = {i: [results[i]["value"]] for i, *_ in singles}
+            # (value, tainted) pairs: a timed-out drain's sample is
+            # deflated (timeout in the denominator) — same protocol as the
+            # default run: exclude it unless it is all we have, flag the row.
+            samples = {i: [(results[i]["value"],
+                            bool(results[i].get("drain_incomplete")))]
+                       for i, *_ in singles}
             for rep in range(1, args.repeats):
                 log(f"===== --all: interleaved repeat {rep + 1}/"
                     f"{args.repeats} (throughput only) =====")
@@ -1194,13 +1199,18 @@ def main() -> None:
                     a = entry_args(name, overrides)
                     a.skip_latency = True
                     try:
-                        samples[i].append(run_single(a)["value"])
+                        r = run_single(a)
+                        samples[i].append(
+                            (r["value"], bool(r.get("drain_incomplete"))))
                     except Exception as e:
                         log(f"repeat for {results[i]['config']} "
                             f"FAILED: {e!r}")
             for i, *_ in singles:
                 row = results[i]
-                row.update(sample_stats(samples[i]))  # median headline
+                clean = [v for v, t in samples[i] if not t]
+                if len(clean) < len(samples[i]):
+                    row["drain_incomplete"] = True
+                row.update(sample_stats(clean or [v for v, _ in samples[i]]))
                 row["vs_baseline"] = round(
                     row["value"] / BASELINE_IMGS_PER_SEC_PER_CHIP, 3)
             # Rank stability: could two rows swap order within their

@@ -225,6 +225,12 @@ class TopologyConfig:
     # granularity; N>1 amortizes ledger/executor overhead at high message
     # rates (replay granularity becomes the chunk). BENCH_NOTES.md.
     spout_chunk: int = 1
+    # Tuple-value scheme (Storm StringScheme vs RawScheme,
+    # MainTopology.java:100): "string" = decode records to str (compatible
+    # with every component incl. shell/multilang and dist-run's JSON tuple
+    # transport); "raw" = emit broker bytes untouched, skipping a
+    # bytes->str->bytes round trip on the inference hot path.
+    spout_scheme: str = "string"
     message_timeout_s: float = 30.0  # at-least-once replay timeout
     inbox_capacity: int = 4096  # bounded executor queues (backpressure)
     tick_interval_s: float = 0.0  # 0 = no tick tuples
@@ -357,6 +363,8 @@ class PipelineConfig:
     # Records per spout tuple for THIS pipeline; 0 = inherit
     # topology.spout_chunk.
     spout_chunk: int = 0
+    # "" = inherit topology.spout_scheme (see TopologyConfig).
+    spout_scheme: str = ""
     spout_parallelism: int = 1
     inference_parallelism: int = 1
     sink_parallelism: int = 1

@@ -61,6 +61,7 @@ class BrokerSpout(Spout):
         offsets: Optional[OffsetsConfig] = None,
         fetch_size: int = 256,
         chunk: int = 0,
+        scheme: str = "string",
     ) -> None:
         self.broker = broker
         self.topic = topic
@@ -72,12 +73,22 @@ class BrokerSpout(Spout):
         # per-record asyncio overhead is the host-side throughput cap at
         # high message rates. Failure granularity becomes the chunk.
         self.chunk = chunk
+        # Tuple-value scheme, Storm's StringScheme vs RawScheme
+        # (MainTopology.java:100 picks StringScheme): "string" decodes each
+        # record to str (full compat: shell/multilang bolts, dist-run's
+        # JSON tuple transport). "raw" emits the broker bytes untouched —
+        # the JSON decoder parses bytes natively, so the hot path skips a
+        # bytes->str->bytes round trip (~20us/record on a 12KB payload).
+        # Not valid with components that JSON-serialize tuple values.
+        if scheme not in ("string", "raw"):
+            raise ValueError(f"unknown spout scheme {scheme!r}")
+        self.scheme = scheme
 
     def clone(self) -> "BrokerSpout":
         """Per-task instance sharing the broker handle (the broker is a
         shared external resource, not per-task state)."""
         return type(self)(self.broker, self.topic, self.offsets_cfg,
-                          self.fetch_size, self.chunk)
+                          self.fetch_size, self.chunk, self.scheme)
 
     def open(self, context: TopologyContext, collector: OutputCollector) -> None:
         super().open(context, collector)
@@ -344,12 +355,17 @@ class BrokerSpout(Spout):
         age = time.time() - rec.timestamp
         return now_perf - max(age, 0.0)
 
+    def _scheme_value(self, value: bytes):
+        if self.scheme == "raw":
+            return value
+        return value.decode("utf-8", "replace")
+
     async def _emit_chunk(self, records: "list[Record]") -> None:
         first, last = records[0], records[-1]
         msg_id = ("c", first.partition, first.offset, last.offset)
         self.pending[msg_id] = records
         await self.collector.emit(
-            Values([[r.value.decode("utf-8", "replace") for r in records]]),
+            Values([[self._scheme_value(r.value) for r in records]]),
             msg_id=msg_id,
             # Oldest record in the chunk: its queueing is the one that counts.
             root_ts=self._append_root_ts(first),
@@ -361,7 +377,7 @@ class BrokerSpout(Spout):
         msg_id = (rec.partition, rec.offset)
         self.pending[msg_id] = rec
         await self.collector.emit(
-            Values([rec.value.decode("utf-8", "replace")]),
+            Values([self._scheme_value(rec.value)]),
             msg_id=msg_id,
             root_ts=self._append_root_ts(rec),
             origins=frozenset({(self.topic, rec.partition, rec.offset + 1)}),

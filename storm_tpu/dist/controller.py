@@ -114,6 +114,19 @@ class DistCluster:
     ) -> Dict[str, int]:
         """Ship the recipe to every worker and start it (two-phase).
         Returns the placement used."""
+        # Known-statically incompatible: raw-scheme (bytes) tuple values
+        # cannot cross the JSON inter-worker transport. Rejecting here
+        # fails fast; the per-batch TypeError in transport.encode_deliveries
+        # would otherwise be swallowed by the send loop's warn-and-replay,
+        # livelocking the topology (review r4).
+        schemes = [cfg.topology.spout_scheme] + [
+            p.spout_scheme or cfg.topology.spout_scheme
+            for p in getattr(cfg, "pipelines", [])]
+        if "raw" in schemes:
+            raise ValueError(
+                "spout_scheme='raw' emits bytes tuple values, which cannot "
+                "cross dist-run's JSON tuple transport; use "
+                "topology.spout_scheme='string' for distributed topologies")
         if placement is None:
             placement = self._auto_place(cfg, builder)
         bad = {c: w for c, w in placement.items() if w >= len(self.clients)}

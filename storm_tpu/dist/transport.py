@@ -90,9 +90,17 @@ def decode_tuple(enc: list, now: float) -> Tuple:
 def encode_deliveries(deliveries: Iterable[Tup[str, int, Tuple]]) -> bytes:
     """deliveries: (component_id, task_index, tuple) triples."""
     now = time.perf_counter()
-    return json.dumps(
-        [[c, i, encode_tuple(t, now)] for c, i, t in deliveries]
-    ).encode("utf-8")
+    try:
+        return json.dumps(
+            [[c, i, encode_tuple(t, now)] for c, i, t in deliveries]
+        ).encode("utf-8")
+    except TypeError as e:
+        # The likeliest non-JSON value is a raw-scheme (bytes) payload.
+        raise TypeError(
+            "tuple values must be JSON-serializable to cross the "
+            "inter-worker transport; spout scheme='raw' (bytes values) "
+            "requires topology.spout_scheme='string' under dist-run"
+        ) from e
 
 
 def decode_deliveries(payload: bytes) -> List[Tup[str, int, Tuple]]:

@@ -171,6 +171,10 @@ class InferenceBolt(Bolt):
 
     async def _emit_dead_letter(self, anchor: Tuple, payload, error: str) -> None:
         self._m_dead.inc()
+        if isinstance(payload, (bytes, bytearray)):
+            # raw-scheme tuples: the DLQ envelope is JSON, so carry the
+            # payload as text, not a bytes repr
+            payload = payload.decode("utf-8", "replace")
         dl = DeadLetter(payload=str(payload), error=error)
         await self.collector.emit(
             Values([dl.to_json(), *self._extras(anchor)]),

@@ -48,7 +48,8 @@ def build_standard_topology(cfg: Config, broker):
     tb.set_spout(
         "kafka-spout",
         BrokerSpout(broker, cfg.broker.input_topic, cfg.offsets,
-                    chunk=cfg.topology.spout_chunk),
+                    chunk=cfg.topology.spout_chunk,
+                    scheme=cfg.topology.spout_scheme),
         parallelism=cfg.topology.spout_parallelism,
     )
     tb.set_bolt(
@@ -90,7 +91,8 @@ def build_multi_model_topology(cfg: Config, broker):
         tb.set_spout(
             spout_id,
             BrokerSpout(broker, p.input_topic, p.offsets,
-                        chunk=p.spout_chunk or cfg.topology.spout_chunk),
+                        chunk=p.spout_chunk or cfg.topology.spout_chunk,
+                        scheme=p.spout_scheme or cfg.topology.spout_scheme),
             parallelism=p.spout_parallelism,
         )
         tb.set_bolt(

@@ -62,6 +62,26 @@ def test_ack_envelope_roundtrip():
     assert transport.decode_acks(transport.encode_acks(ops)) == ops
 
 
+def test_raw_scheme_rejected_at_submit():
+    """spout_scheme='raw' (bytes tuple values) is statically incompatible
+    with the JSON tuple transport; submit must fail fast, not livelock in
+    warn-and-replay (the per-batch encode error is swallowed by the send
+    loop)."""
+    cfg = Config()
+    cfg.topology.spout_scheme = "raw"
+    dc = DistCluster.__new__(DistCluster)  # validation precedes any state
+    with pytest.raises(ValueError, match="raw"):
+        dc.submit("t", cfg)
+
+
+def test_raw_scheme_bytes_rejected_by_transport():
+    t = Tuple(values=[b"raw-bytes"], fields=("message",),
+              source_component="spout", source_task=0, stream="default",
+              edge_id=1, anchors=frozenset(), root_ts=0.0)
+    with pytest.raises(TypeError, match="spout_scheme='string'"):
+        transport.encode_deliveries([("bolt", 0, t)])
+
+
 @pytest.mark.slow
 def test_dist_three_workers_end_to_end():
     """spout(w0) -> inference(w1) -> sink(w2), Kafka stub shared by all."""

@@ -22,7 +22,8 @@ def _payload(n=1, seed=0):
     return json.dumps({"instances": x.tolist()})
 
 
-async def _run_e2e(n_msgs=12, poison_at=None, max_batch=8, max_wait_ms=20):
+async def _run_e2e(n_msgs=12, poison_at=None, max_batch=8, max_wait_ms=20,
+                   scheme="string", chunk=0):
     broker = MemoryBroker(default_partitions=2)
     cfg = Config()
     model_cfg = ModelConfig(name="lenet5", dtype="float32", input_shape=(28, 28, 1))
@@ -32,7 +33,8 @@ async def _run_e2e(n_msgs=12, poison_at=None, max_batch=8, max_wait_ms=20):
     tb = TopologyBuilder()
     tb.set_spout(
         "kafka-spout",
-        BrokerSpout(broker, "input", OffsetsConfig(policy="earliest", max_behind=None)),
+        BrokerSpout(broker, "input", OffsetsConfig(policy="earliest", max_behind=None),
+                    chunk=chunk, scheme=scheme),
         parallelism=2,
     )
     tb.set_bolt(
@@ -94,6 +96,27 @@ def test_e2e_poison_goes_to_dead_letter(run):
     # Poison tuple was acked (not replayed forever), good tuples unaffected.
     assert snap["kafka-spout"]["tree_acked"] == 6
     assert snap["inference-bolt"]["dead_lettered"] == 1
+
+
+def test_e2e_raw_scheme_bytes_hot_path(run):
+    """scheme='raw' (Storm RawScheme analog): broker bytes flow to the
+    decoder untouched — predictions still correct, and a poison record's
+    DLQ envelope carries the payload as text, never a bytes repr."""
+    outs, dlq, snap = run(
+        _run_e2e(n_msgs=6, poison_at=2, scheme="raw", chunk=2), timeout=120)
+    assert len(outs) == 5
+    assert len(dlq) == 1
+    dl = json.loads(dlq[0].value)
+    assert dl["stage"] == "decode"
+    assert "instances" in dl["payload"]
+    assert not dl["payload"].startswith("b'")
+    for r in outs:
+        preds = decode_predictions(r.value)
+        assert preds.data.shape == (1, 10)
+    # chunked tuples: trees == chunks, not records; every chunk acked
+    assert snap["inference-bolt"]["dead_lettered"] == 1
+    assert snap["kafka-spout"]["tree_acked"] >= 3
+    assert snap["kafka-spout"].get("tree_failed", 0) == 0
 
 
 def test_e2e_latency_histogram_recorded(run):

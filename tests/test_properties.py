@@ -143,6 +143,42 @@ def test_instances_json_roundtrip(n, h, w, c, seed):
     np.testing.assert_allclose(inst.data, x, rtol=1e-6, atol=1e-7)
 
 
+@settings(max_examples=150, deadline=None)
+@given(
+    vals=st.lists(
+        st.floats(allow_nan=False, allow_infinity=False, width=32),
+        min_size=1, max_size=16),
+    indent=st.sampled_from([None, 1]),
+)
+def test_native_parser_matches_python_fallback(vals, indent):
+    """Differential fuzz: the C++ parser and the pure-Python json path must
+    agree to 1 ulp on arbitrary float32 JSON — including scientific
+    notation ('1e-07'), 17-significant-digit repr output (exceeds the
+    fixed-point fast path, exercising the from_chars fallback), negative
+    zero, subnormals, and indent whitespace."""
+    import pytest
+
+    from storm_tpu.native import native_available, parse_instances_native
+
+    if not native_available():
+        pytest.skip("native library not built")
+    payload = json.dumps({"instances": [vals]}, indent=indent)
+    native = parse_instances_native(payload)
+    expected = np.asarray(json.loads(payload)["instances"],
+                          dtype=np.float32)
+    assert native.shape == expected.shape
+
+    def ulp_ordered(x):
+        # monotonic integer mapping of float32 bit patterns (+0 == -0);
+        # np.testing's nulp helper overflows np.spacing near float32 max
+        u = np.ascontiguousarray(x, np.float32).view(np.uint32)\
+            .astype(np.int64)
+        return np.where(u < 1 << 31, u + (1 << 31), (1 << 32) - u)
+
+    diff = np.abs(ulp_ordered(native) - ulp_ordered(expected))
+    assert int(diff.max()) <= 1, (native, expected)
+
+
 # ---- micro-batcher -----------------------------------------------------------
 
 
