@@ -272,6 +272,9 @@ class BrokerConfig:
     # 95-118) but a production contract should. SASL mechanism: PLAIN
     # (the era's standard; tokens are raw pre-KIP-152 frames).
     security_protocol: str = "PLAINTEXT"  # | SSL | SASL_PLAINTEXT | SASL_SSL
+    # PLAIN (era standard) | SCRAM-SHA-256 | SCRAM-SHA-512 (KIP-84;
+    # password never crosses the wire, server signature verified)
+    sasl_mechanism: str = "PLAIN"
     sasl_username: str = ""
     sasl_password: str = ""
     ssl_cafile: str = ""  # CA bundle for broker cert verification
@@ -289,7 +292,7 @@ class BrokerConfig:
             return None
         return {
             "protocol": self.security_protocol,
-            "sasl_mechanism": "PLAIN",
+            "sasl_mechanism": self.sasl_mechanism,
             "sasl_username": self.sasl_username,
             "sasl_password": self.sasl_password,
             "ssl_cafile": self.ssl_cafile or None,
@@ -323,6 +326,15 @@ class BrokerConfig:
             raise ValueError(
                 "broker.security_protocol must be PLAINTEXT|SSL|"
                 f"SASL_PLAINTEXT|SASL_SSL, got {self.security_protocol!r}")
+        # lazy import: config is foundational and the connectors package
+        # imports it back at module load (spout/sink), so a top-level
+        # import here would cycle through a half-initialized module
+        from storm_tpu.connectors.kafka_protocol import SASL_MECHANISMS
+
+        if self.sasl_mechanism not in SASL_MECHANISMS:
+            raise ValueError(
+                "broker.sasl_mechanism must be one of "
+                f"{'|'.join(SASL_MECHANISMS)}, got {self.sasl_mechanism!r}")
         if (self.security_protocol.startswith("SASL")
                 and not self.sasl_username):
             raise ValueError(

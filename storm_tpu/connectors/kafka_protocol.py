@@ -49,6 +49,9 @@ from typing import Dict, List, Optional, Tuple
 
 from storm_tpu.connectors.memory import Record
 
+#: SASL mechanisms the wire client speaks; SCRAM per RFC 5802/7677.
+SASL_MECHANISMS = ("PLAIN", "SCRAM-SHA-256", "SCRAM-SHA-512")
+
 logger = logging.getLogger("storm_tpu.kafka")
 
 
@@ -570,15 +573,21 @@ class _Conn:
             self.close()
             raise
 
+    _SCRAM_ALGOS = {"SCRAM-SHA-256": "sha256", "SCRAM-SHA-512": "sha512"}
+
     def _sasl_plain(self, security: dict) -> None:
-        """0.10/0.11-era SASL/PLAIN: a Kafka-framed SaslHandshake (api 17
-        v0) naming the mechanism, then RAW length-prefixed token frames —
-        the tokens are not wrapped in the Kafka protocol until KIP-152
-        (broker 1.0+); this client speaks the era of its pinned APIs."""
+        """0.10/0.11-era SASL: a Kafka-framed SaslHandshake (api 17 v0)
+        naming the mechanism, then RAW length-prefixed token frames — the
+        tokens are not wrapped in the Kafka protocol until KIP-152 (broker
+        1.0+); this client speaks the era of its pinned APIs. Mechanisms:
+        PLAIN (the era's standard) and SCRAM-SHA-256/-512 (KIP-84,
+        broker 0.10.2+ — the password never crosses the wire, and the
+        server signature is verified for mutual authentication)."""
         mech = security.get("sasl_mechanism", "PLAIN")
-        if mech != "PLAIN":
+        if mech not in SASL_MECHANISMS:
             raise KafkaProtocolError(
-                f"unsupported sasl_mechanism {mech!r} (PLAIN only)")
+                f"unsupported sasl_mechanism {mech!r} "
+                f"(one of {list(SASL_MECHANISMS)})")
         r = self.request(17, 0, bytes(Writer().string(mech).buf))
         err = r.i16()
         mechs = [r.string() for _ in range(max(0, r.i32()))]
@@ -589,22 +598,108 @@ class _Conn:
                 f"{mechs}", code=err)
         user = security.get("sasl_username") or ""
         pwd = security.get("sasl_password") or ""
-        token = b"\x00" + user.encode() + b"\x00" + pwd.encode()
         with self.lock:
-            # success = an (empty) server token; failure = broker closes
-            # (FIN -> KafkaProtocolError from _recv, RST -> OSError) —
-            # both must surface AS an auth failure, not leak out as a
-            # transport error the leader-retry path would re-auth against
-            # with the same bad credentials.
+            if mech == "PLAIN":
+                self._sasl_token(
+                    mech, b"\x00" + user.encode() + b"\x00" + pwd.encode())
+            else:
+                self._sasl_scram(mech, user, pwd)
+
+    def _sasl_token(self, mech: str, token: bytes) -> bytes:
+        """One raw (pre-KIP-152) token round trip. Caller holds the lock.
+
+        Success = a (possibly empty) server token; failure = broker closes
+        (FIN -> KafkaProtocolError from _recv, RST -> OSError) — both must
+        surface AS an auth failure, not leak out as a transport error the
+        leader-retry path would re-auth against with the same bad
+        credentials."""
+        try:
+            self.sock.sendall(struct.pack(">i", len(token)) + token)
+            size = struct.unpack(">i", self._recv(4))[0]
+            return self._recv(size) if size > 0 else b""
+        except (KafkaProtocolError, OSError) as e:
+            raise KafkaProtocolError(
+                f"SASL/{mech} authentication failed (broker closed the "
+                f"connection): {e}") from e
+
+    def _sasl_scram(self, mech: str, user: str, pwd: str) -> None:
+        """SCRAM client exchange (RFC 5802/7677 over Kafka raw frames)."""
+        import base64
+        import hashlib
+        import hmac as hmac_mod
+        import os
+
+        algo = self._SCRAM_ALGOS[mech]
+
+        def hm(key: bytes, data: bytes) -> bytes:
+            return hmac_mod.new(key, data, algo).digest()
+
+        def fields_of(msg: bytes, what: str) -> dict:
             try:
-                self.sock.sendall(struct.pack(">i", len(token)) + token)
-                size = struct.unpack(">i", self._recv(4))[0]
-                if size > 0:
-                    self._recv(size)
-            except (KafkaProtocolError, OSError) as e:
+                return dict(kv.split("=", 1)
+                            for kv in msg.decode("utf-8").split(","))
+            except ValueError:
                 raise KafkaProtocolError(
-                    "SASL/PLAIN authentication failed (broker closed the "
-                    f"connection): {e}") from e
+                    f"{mech}: malformed {what} message {msg!r}") from None
+
+        def b64(s: str, what: str) -> bytes:
+            # keep malformed-server failures inside the module's error
+            # taxonomy (KafkaProtocolError/OSError — what callers and the
+            # retry paths catch), never a bare binascii/ValueError
+            try:
+                return base64.b64decode(s, validate=True)
+            except (ValueError, TypeError):
+                raise KafkaProtocolError(
+                    f"{mech}: malformed base64 in {what}: {s!r}") from None
+
+        esc = user.replace("=", "=3D").replace(",", "=2C")
+        cnonce = base64.b64encode(os.urandom(18)).decode()
+        first_bare = f"n={esc},r={cnonce}"
+        server_first = self._sasl_token(mech, b"n,," + first_bare.encode())
+        f = fields_of(server_first, "server-first")
+        snonce = f.get("r", "")
+        try:
+            iterations = int(f.get("i", "0"))
+        except ValueError:
+            raise KafkaProtocolError(
+                f"{mech}: non-integer iteration count "
+                f"{f.get('i')!r}") from None
+        if not snonce.startswith(cnonce) or len(snonce) <= len(cnonce):
+            raise KafkaProtocolError(
+                f"{mech}: server nonce does not extend the client nonce")
+        if "s" not in f:
+            raise KafkaProtocolError(
+                f"{mech}: bad server-first message {server_first!r}")
+        # RFC 7677 floor: an attacker posing as the broker must not be
+        # able to request i=1 and dictionary-crack the resulting proof
+        # ~4096x faster; huge i would hang connect in CPU-bound PBKDF2
+        # that no socket timeout covers.
+        if not 4096 <= iterations <= 10_000_000:
+            raise KafkaProtocolError(
+                f"{mech}: iteration count {iterations} outside the "
+                "accepted range [4096, 10000000]")
+        salted = hashlib.pbkdf2_hmac(
+            algo, pwd.encode(), b64(f["s"], "salt"), iterations)
+        client_key = hm(salted, b"Client Key")
+        final_wo_proof = f"c=biws,r={snonce}"  # biws = b64("n,,")
+        auth_msg = ",".join((first_bare, server_first.decode("utf-8"),
+                             final_wo_proof)).encode()
+        signature = hm(hashlib.new(algo, client_key).digest(), auth_msg)
+        proof = bytes(a ^ b for a, b in zip(client_key, signature))
+        final = (final_wo_proof + ",p="
+                 + base64.b64encode(proof).decode()).encode()
+        server_final = self._sasl_token(mech, final)
+        f = fields_of(server_final, "server-final")
+        if "e" in f:
+            raise KafkaProtocolError(
+                f"SASL/{mech} authentication failed: {f['e']}")
+        # Mutual auth: a broker that doesn't hold the credentials cannot
+        # produce this signature — verification is mandatory, not optional.
+        expected = hm(hm(salted, b"Server Key"), auth_msg)
+        if b64(f.get("v", ""), "server signature") != expected:
+            raise KafkaProtocolError(
+                f"SASL/{mech}: server signature mismatch (the broker does "
+                "not hold these credentials — possible man-in-the-middle)")
 
     def request(
         self, api_key: int, api_version: int, body: bytes, oneway: bool = False

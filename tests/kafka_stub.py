@@ -47,6 +47,15 @@ class KafkaStubBroker:
     #: wrong credentials close the socket like a real broker.
     sasl: "tuple | None" = None
 
+    #: SASL mechanisms the stub advertises/accepts: "PLAIN" (default) or
+    #: "SCRAM-SHA-256"/"SCRAM-SHA-512" (full RFC 5802 server exchange,
+    #: proof verified via StoredKey, server signature returned).
+    sasl_mechanism = "PLAIN"
+
+    #: SCRAM PBKDF2 iteration count the stub requests (lower it to test
+    #: the client's RFC 7677 downgrade refusal).
+    scram_iterations = 4096
+
     #: SSL: an ssl.SSLContext to wrap accepted connections with (combine
     #: with ``sasl`` for SASL_SSL).
     ssl_context = None
@@ -166,25 +175,26 @@ class KafkaStubBroker:
                     if api_key != 17:
                         return  # real brokers drop pre-auth requests
                     mech = r.string()
+                    ok = mech == self.sasl_mechanism
                     w = Writer()
-                    w.i16(0 if mech == "PLAIN" else 33)  # UNSUPPORTED_SASL
-                    w.i32(1).string("PLAIN")
+                    w.i16(0 if ok else 33)  # UNSUPPORTED_SASL_MECHANISM
+                    w.i32(1).string(self.sasl_mechanism)
                     resp = struct.pack(">i", corr) + bytes(w.buf)
                     conn.sendall(struct.pack(">i", len(resp)) + resp)
-                    if mech != "PLAIN":
+                    if not ok:
                         return
-                    # raw (pre-KIP-152) token frame: \0user\0password
-                    tok_head = self._recv(conn, 4)
-                    if tok_head is None:
-                        return
-                    token = self._recv(conn, struct.unpack(
-                        ">i", tok_head)[0])
-                    parts = (token or b"").split(b"\x00")
-                    if (len(parts) != 3
-                            or parts[1].decode() != self.sasl[0]
-                            or parts[2].decode() != self.sasl[1]):
-                        return  # auth failure: close, like a real broker
-                    conn.sendall(struct.pack(">i", 0))  # empty server token
+                    if mech == "PLAIN":
+                        # raw (pre-KIP-152) token frame: \0user\0password
+                        token = self._recv_token(conn)
+                        parts = (token or b"").split(b"\x00")
+                        if (len(parts) != 3
+                                or parts[1].decode() != self.sasl[0]
+                                or parts[2].decode() != self.sasl[1]):
+                            return  # auth failure: close, like a real broker
+                        conn.sendall(struct.pack(">i", 0))  # empty token
+                    else:
+                        if not self._scram_serve(conn, mech):
+                            return
                     authed = True
                     continue
                 body = self._dispatch(api_key, api_version, r, node)
@@ -194,6 +204,62 @@ class KafkaStubBroker:
             pass
         finally:
             conn.close()
+
+    @classmethod
+    def _recv_token(cls, conn: socket.socket) -> Optional[bytes]:
+        head = cls._recv(conn, 4)
+        if head is None:
+            return None
+        return cls._recv(conn, struct.unpack(">i", head)[0])
+
+    def _scram_serve(self, conn: socket.socket, mech: str) -> bool:
+        """RFC 5802 server side over raw token frames: verify the client
+        proof against StoredKey, return the server signature. False =
+        auth failure (caller closes, like a real broker)."""
+        import base64
+        import hashlib
+        import hmac as hmac_mod
+        import os
+
+        algo = mech.replace("SCRAM-SHA-", "sha")
+
+        def hm(key: bytes, data: bytes) -> bytes:
+            return hmac_mod.new(key, data, algo).digest()
+
+        first = self._recv_token(conn)
+        if first is None or not first.startswith(b"n,,"):
+            return False
+        first_bare = first[3:].decode()
+        f = dict(kv.split("=", 1) for kv in first_bare.split(","))
+        user = f["n"].replace("=2C", ",").replace("=3D", "=")
+        if user != self.sasl[0]:
+            return False
+        salt, iterations = os.urandom(12), self.scram_iterations
+        snonce = f["r"] + base64.b64encode(os.urandom(12)).decode()
+        server_first = (f"r={snonce},s={base64.b64encode(salt).decode()},"
+                        f"i={iterations}")
+        conn.sendall(struct.pack(">i", len(server_first))
+                     + server_first.encode())
+        final = self._recv_token(conn)
+        if final is None:
+            return False
+        ff = dict(kv.split("=", 1) for kv in final.decode().split(","))
+        if ff.get("c") != "biws" or ff.get("r") != snonce or "p" not in ff:
+            return False
+        salted = hashlib.pbkdf2_hmac(
+            algo, self.sasl[1].encode(), salt, iterations)
+        stored_key = hashlib.new(algo, hm(salted, b"Client Key")).digest()
+        final_wo = final.decode().rsplit(",p=", 1)[0]
+        auth_msg = ",".join((first_bare, server_first, final_wo)).encode()
+        signature = hm(stored_key, auth_msg)
+        client_key = bytes(a ^ b for a, b in zip(
+            base64.b64decode(ff["p"]), signature))
+        if hashlib.new(algo, client_key).digest() != stored_key:
+            return False  # wrong password
+        v = base64.b64encode(hm(hm(salted, b"Server Key"), auth_msg))
+        server_final = b"v=" + v
+        conn.sendall(struct.pack(">i", len(server_final)) + server_final)
+        return True
 
     @staticmethod
     def _recv(conn: socket.socket, n: int) -> Optional[bytes]:

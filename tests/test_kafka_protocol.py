@@ -1454,6 +1454,82 @@ def test_sasl_plain_round_trip():
         stub3.close()
 
 
+@pytest.mark.parametrize("mech", ["SCRAM-SHA-256", "SCRAM-SHA-512"])
+def test_sasl_scram_round_trip(mech):
+    """SASL/SCRAM (KIP-84): full RFC 5802 exchange over raw token frames —
+    salted-password proof verified server-side, server signature verified
+    client-side; produce/fetch work over the authenticated socket."""
+    stub = KafkaStubBroker(partitions=1)
+    stub.sasl = ("svc", "scram-pw")
+    stub.sasl_mechanism = mech
+    sec = {"protocol": "SASL_PLAINTEXT", "sasl_mechanism": mech,
+           "sasl_username": "svc", "sasl_password": "scram-pw"}
+    client = KafkaWireClient(f"127.0.0.1:{stub.port}", security=sec)
+    try:
+        client.produce("t", 0, [(None, b"scrammed")])
+        recs = client.fetch("t", 0, 0, max_wait_ms=10)
+        assert [r.value for r in recs] == [b"scrammed"]
+    finally:
+        client.close()
+        stub.close()
+
+
+def test_sasl_scram_wrong_password_fails_loudly():
+    stub = KafkaStubBroker(partitions=1)
+    stub.sasl = ("svc", "scram-pw")
+    stub.sasl_mechanism = "SCRAM-SHA-256"
+    bad = KafkaWireClient(
+        f"127.0.0.1:{stub.port}",
+        security={"protocol": "SASL_PLAINTEXT",
+                  "sasl_mechanism": "SCRAM-SHA-256",
+                  "sasl_username": "svc", "sasl_password": "nope"})
+    try:
+        with pytest.raises((KafkaProtocolError, OSError)):
+            bad.produce("t", 0, [(None, b"x")])
+    finally:
+        bad.close()
+        stub.close()
+
+
+def test_sasl_scram_refuses_downgraded_iteration_count():
+    """A server (or MITM) requesting i < 4096 (RFC 7677 floor) must be
+    refused — accepting would let an attacker dictionary-crack the proof
+    thousands of times faster."""
+    stub = KafkaStubBroker(partitions=1)
+    stub.sasl = ("svc", "scram-pw")
+    stub.sasl_mechanism = "SCRAM-SHA-256"
+    stub.scram_iterations = 512
+    client = KafkaWireClient(
+        f"127.0.0.1:{stub.port}",
+        security={"protocol": "SASL_PLAINTEXT",
+                  "sasl_mechanism": "SCRAM-SHA-256",
+                  "sasl_username": "svc", "sasl_password": "scram-pw"})
+    try:
+        with pytest.raises(KafkaProtocolError, match="iteration count"):
+            client.produce("t", 0, [(None, b"x")])
+    finally:
+        client.close()
+        stub.close()
+
+
+def test_sasl_scram_mechanism_mismatch_names_brokers_offer():
+    """A PLAIN-only broker refusing SCRAM surfaces error 33 + the broker's
+    supported list, not a hang or a silent close."""
+    stub = KafkaStubBroker(partitions=1)
+    stub.sasl = ("svc", "pw")  # mechanism stays PLAIN
+    client = KafkaWireClient(
+        f"127.0.0.1:{stub.port}",
+        security={"protocol": "SASL_PLAINTEXT",
+                  "sasl_mechanism": "SCRAM-SHA-256",
+                  "sasl_username": "svc", "sasl_password": "pw"})
+    try:
+        with pytest.raises(KafkaProtocolError, match="PLAIN"):
+            client.produce("t", 0, [(None, b"x")])
+    finally:
+        client.close()
+        stub.close()
+
+
 @pytest.fixture(scope="module")
 def ssl_certs(tmp_path_factory):
     import subprocess
