@@ -127,8 +127,8 @@ def test_e2e_latency_histogram_recorded(run):
 
 
 def test_standard_topology_spout_chunk_config(run):
-    """topology.spout_chunk=N flows into the built spout and the pipeline
-    still delivers every record."""
+    """topology.spout_chunk=N and spout_scheme flow into the built spout
+    and the pipeline still delivers every record."""
     from storm_tpu.main import _make_broker, build_standard_topology
 
     cfg = Config()
@@ -139,6 +139,7 @@ def test_standard_topology_spout_chunk_config(run):
     cfg.batch.max_batch = 8
     cfg.batch.buckets = (8,)
     cfg.topology.spout_chunk = 3
+    cfg.topology.spout_scheme = "raw"
     cfg.topology.spout_parallelism = 1
     cfg.topology.inference_parallelism = 1
     cfg.topology.sink_parallelism = 1
@@ -147,6 +148,7 @@ def test_standard_topology_spout_chunk_config(run):
         broker = _make_broker(cfg)
         topo = build_standard_topology(cfg, broker)
         assert topo.specs["kafka-spout"].obj.chunk == 3
+        assert topo.specs["kafka-spout"].obj.scheme == "raw"
         cluster = AsyncLocalCluster()
         rt = await cluster.submit("chunked", cfg, topo)
         rng = np.random.RandomState(0)
