@@ -277,9 +277,14 @@ def make_payloads(cfg, n_distinct=64, instances_per_msg=1):
 def sample_stats(samples) -> dict:
     """The min/median/max honesty protocol shared by the default headline
     (median-of-N back-to-back drains) and the --all interleaved repeats:
-    one definition so the two artifacts can never diverge."""
+    one definition so the two artifacts can never diverge. True median —
+    even-length lists average the middle pair (taking the upper-middle
+    would make a 2-sample headline equal the MAX, biasing upward exactly
+    when a repeat was dropped)."""
     s = sorted(samples)
-    return {"value": s[len(s) // 2], "throughput_samples": s,
+    n = len(s)
+    med = s[n // 2] if n % 2 else round((s[n // 2 - 1] + s[n // 2]) / 2, 1)
+    return {"value": med, "throughput_samples": s,
             "value_min": s[0], "value_max": s[-1]}
 
 
@@ -758,12 +763,20 @@ def run_slo_sweep(args) -> dict:
         # per the done-criterion: show exactly WHERE the 50 ms budget goes
         # when it is unreachable, per stage, at the lightest load point
         lightest = device_curve[0]["stages_p50_ms"]
-        blame = max(lightest, key=lambda k: lightest[k])
-        out["p50_le_50ms_unreachable_because"] = (
-            f"stage '{blame}' alone is {lightest[blame]:.0f} ms at the "
-            f"lightest offered rate (full stage p50s in device_curve[0]); "
-            "the framework_slo_points show the identical pipeline meets "
-            "the SLO when device time is excluded")
+        if lightest:
+            blame = max(lightest, key=lambda k: lightest[k])
+            out["p50_le_50ms_unreachable_because"] = (
+                f"stage '{blame}' alone is {lightest[blame]:.0f} ms at the "
+                f"lightest offered rate (full stage p50s in "
+                "device_curve[0]); the framework_slo_points show the "
+                "identical pipeline meets the SLO when device time is "
+                "excluded")
+        else:
+            # stalled lightest point: no stage histograms to attribute —
+            # emit the sweep with a degraded note instead of crashing
+            out["p50_le_50ms_unreachable_because"] = (
+                "the lightest offered rate recorded no per-stage samples "
+                "(stalled/undelivered windows); see device_curve rows")
     return out
 
 
@@ -948,8 +961,6 @@ def _run_autoscale_inner(args, cfg, cluster, broker, payloads, n_dev,
             log("draining reaction backlog after scale-up...")
             await_outputs(lambda: broker.topic_size("output"), sent,
                           grace_s=120.0)
-            cluster.reset_histogram(
-                "bench-slo", "kafka-bolt", "e2e_latency_ms")
             if breach_mult is None:
                 breach_mult = mult
             # Post-scale stages offer what the SCALED system sustains:
@@ -961,6 +972,11 @@ def _run_autoscale_inner(args, cfg, cluster, broker, payloads, n_dev,
             # one chip's device throughput need more chips (dp mesh),
             # not more bolts.
             mult = min(mult, 0.8 * probe_capacity() / cap1, 1.0)
+            # Reset AFTER the probe (like the cap1/cap_scaled sites): the
+            # probe's burst queue latencies must not land in the first
+            # settle window or trigger a spurious second scale-up.
+            cluster.reset_histogram(
+                "bench-slo", "kafka-bolt", "e2e_latency_ms")
             log(f"settle rate re-based to {mult:.2f}x cap1")
         if ups_so_far():
             if settle >= 2:

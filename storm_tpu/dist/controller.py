@@ -118,15 +118,22 @@ class DistCluster:
         # cannot cross the JSON inter-worker transport. Rejecting here
         # fails fast; the per-batch TypeError in transport.encode_deliveries
         # would otherwise be swallowed by the send loop's warn-and-replay,
-        # livelocking the topology (review r4).
-        schemes = [cfg.topology.spout_scheme] + [
-            p.spout_scheme or cfg.topology.spout_scheme
-            for p in getattr(cfg, "pipelines", [])]
-        if "raw" in schemes:
+        # livelocking the topology (review r4). Build the recipe locally
+        # exactly as each worker will and inspect the REAL spout objects —
+        # a config-only check cannot see raw spouts constructed by a
+        # custom builder (review r4 follow-up).
+        from storm_tpu.connectors import MemoryBroker
+        from storm_tpu.dist.worker import _resolve_builder
+
+        probe_topo = _resolve_builder(builder)(cfg, MemoryBroker())
+        raw_spouts = sorted(
+            cid for cid, spec in probe_topo.specs.items()
+            if getattr(spec.obj, "scheme", None) == "raw")
+        if raw_spouts:
             raise ValueError(
-                "spout_scheme='raw' emits bytes tuple values, which cannot "
-                "cross dist-run's JSON tuple transport; use "
-                "topology.spout_scheme='string' for distributed topologies")
+                f"spout(s) {raw_spouts} use scheme='raw' (bytes tuple "
+                "values), which cannot cross dist-run's JSON tuple "
+                "transport; use scheme='string' for distributed topologies")
         if placement is None:
             placement = self._auto_place(cfg, builder)
         bad = {c: w for c, w in placement.items() if w >= len(self.clients)}

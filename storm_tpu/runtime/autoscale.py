@@ -29,6 +29,18 @@ from typing import Optional
 log = logging.getLogger("storm_tpu.autoscale")
 
 
+# Measured cap for bolts that front a batching accelerator: past ~2-3
+# tasks, deadline flushes fragment micro-batches and throughput inverts
+# (BENCH_NOTES round 2). Use for InferenceBolt autoscale policies;
+# CPU-bound bolts take the Storm-style generous cap instead.
+ACCEL_MAX_PARALLELISM = 3
+
+#: Storm-style cap for CPU-bound bolts, where more executors do scale
+#: (ADVICE r3-low: a round-3 global change to 3 silently stopped
+#: CPU-bound topologies from scaling past 3).
+CPU_MAX_PARALLELISM = 16
+
+
 @dataclass
 class AutoscalePolicy:
     component: str = "inference-bolt"
@@ -36,24 +48,22 @@ class AutoscalePolicy:
     high_ms: float = 200.0
     low_ms: float = 50.0
     min_parallelism: int = 1
-    # Storm-style default: more executors scale CPU-bound bolts, so the
-    # GLOBAL default keeps the generous cap (ADVICE r3-low: a round-3
-    # change to 3 here silently stopped CPU-bound topologies from scaling
-    # past 3). The measured accelerator inversion — in front of a batching
-    # accelerator, parallelism is pipelining depth and 8 bolts benched
-    # ~15% SLOWER than 1 (BENCH_NOTES round 2) — belongs to the INFERENCE
-    # operator's policy, applied where it is configured:
-    # ``ACCEL_MAX_PARALLELISM`` (main.py daemon, bench harness).
-    max_parallelism: int = 16
+    # None = auto by component kind: the default component IS the
+    # inference operator, and scaling a batching-accelerator bolt past
+    # ~2-3 tasks is a measured ~15% REGRESSION (deadline flushes fragment
+    # micro-batches, BENCH_NOTES round 2) — so the standard inference
+    # component ids resolve to ACCEL_MAX_PARALLELISM and everything else
+    # to the Storm-style CPU cap. An explicit value is always honored.
+    max_parallelism: Optional[int] = None
     interval_s: float = 5.0
     cooldown: int = 3  # consecutive calm checks before scaling down
 
-
-# Measured cap for bolts that front a batching accelerator: past ~2-3
-# tasks, deadline flushes fragment micro-batches and throughput inverts
-# (BENCH_NOTES round 2). Use for InferenceBolt autoscale policies; leave
-# the dataclass default for CPU-bound bolts.
-ACCEL_MAX_PARALLELISM = 3
+    def __post_init__(self) -> None:
+        if self.max_parallelism is None:
+            accel = (self.component == "inference-bolt"
+                     or self.component.endswith("-inference"))
+            self.max_parallelism = (
+                ACCEL_MAX_PARALLELISM if accel else CPU_MAX_PARALLELISM)
 
 
 class Autoscaler:
