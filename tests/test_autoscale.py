@@ -27,6 +27,25 @@ async def _mk_runtime():
     return cluster, rt
 
 
+def test_default_cap_resolves_by_component_kind():
+    """max_parallelism=None resolves per component: inference ids get the
+    measured accelerator cap (past ~2-3 tasks micro-batches fragment and
+    throughput inverts), CPU bolts the Storm-style cap; explicit values
+    always win."""
+    from storm_tpu.runtime.autoscale import (
+        ACCEL_MAX_PARALLELISM,
+        CPU_MAX_PARALLELISM,
+    )
+
+    assert AutoscalePolicy().max_parallelism == ACCEL_MAX_PARALLELISM
+    assert AutoscalePolicy(
+        component="mnist-inference").max_parallelism == ACCEL_MAX_PARALLELISM
+    assert AutoscalePolicy(
+        component="parser-bolt").max_parallelism == CPU_MAX_PARALLELISM
+    assert AutoscalePolicy(
+        component="inference-bolt", max_parallelism=8).max_parallelism == 8
+
+
 def test_scales_up_on_high_latency(run):
     async def go():
         cluster, rt = await _mk_runtime()
