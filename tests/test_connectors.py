@@ -364,6 +364,69 @@ def test_spout_group_protocol_splits_partitions(run):
     run(go(), timeout=120)
 
 
+def test_topology_over_scram_authenticated_broker(run):
+    """Full spout -> bolt -> sink path over a SCRAM-authenticated wire
+    broker, with the security dict built from BrokerConfig — the daemon's
+    config surface. Every connection (spout fetch, sink produce, metadata)
+    authenticates via the RFC 5802 exchange."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tests")
+    from kafka_stub import KafkaStubBroker
+    from storm_tpu.config import BrokerConfig
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+    from storm_tpu.runtime import Bolt
+
+    class Echo(Bolt):
+        async def execute(self, t):
+            await self.collector.emit([t.get("message")], anchors=[t])
+            self.collector.ack(t)
+
+    async def go():
+        stub = KafkaStubBroker(partitions=2)
+        stub.sasl = ("svc", "scram-pw")
+        stub.sasl_mechanism = "SCRAM-SHA-256"
+        try:
+            bcfg = BrokerConfig(
+                kind="kafka", bootstrap=f"127.0.0.1:{stub.port}",
+                security_protocol="SASL_PLAINTEXT",
+                sasl_mechanism="SCRAM-SHA-256",
+                sasl_username="svc", sasl_password="scram-pw")
+            broker = KafkaWireBroker(bcfg.bootstrap,
+                                     security=bcfg.security_dict())
+            for i in range(6):
+                broker.produce("sin", f"r{i}", key=str(i))
+            cfg = Config()
+            tb = TopologyBuilder()
+            tb.set_spout("spout", BrokerSpout(
+                broker, "sin",
+                OffsetsConfig(policy="earliest", max_behind=None)),
+                parallelism=1)
+            tb.set_bolt("echo", Echo(), parallelism=1)\
+                .shuffle_grouping("spout")
+            tb.set_bolt("sink", BrokerSink(broker, "sout", cfg.sink),
+                        parallelism=1).shuffle_grouping("echo")
+            cluster = AsyncLocalCluster()
+            rt = await cluster.submit("scram-topo", cfg, tb.build())
+            got = set()
+            deadline = asyncio.get_event_loop().time() + 60
+            while asyncio.get_event_loop().time() < deadline:
+                for p in range(2):
+                    for rec in broker.client.fetch("sout", p, 0,
+                                                   max_wait_ms=10):
+                        got.add(rec.value.decode())
+                if len(got) >= 6:
+                    break
+                await asyncio.sleep(0.1)
+            assert got == {f"r{i}" for i in range(6)}
+            await rt.drain(timeout_s=20)
+            await cluster.shutdown()
+        finally:
+            stub.close()
+
+    run(go(), timeout=120)
+
+
 def test_spout_group_protocol_requires_wire_broker():
     from storm_tpu.runtime.base import OutputCollector
 
