@@ -834,6 +834,101 @@ def test_eos_consume_transform_produce_crash(stub, run):
     feeder.close()
 
 
+def test_eos_chaos_soak_moves_and_failures(run):
+    """Exactly-once under COMBINED churn — the individual machines are
+    each tested above; this soaks them together: txn spout -> fan-out
+    transform (two outputs per record + one forced mid-stream tuple
+    failure and replay) -> transactional sink, while the output
+    partition's leader AND the group/txn coordinator both migrate
+    mid-stream. A read-committed consumer must see each input's two
+    outputs exactly once (no loss from the moves, no dupes from the
+    replay), and the committed group offsets must cover the whole log."""
+    from storm_tpu.config import SinkConfig
+    from storm_tpu.connectors import BrokerSpout, TransactionalBrokerSink
+    from storm_tpu.runtime import Bolt, TopologyBuilder, Values
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    GROUP = "soak-g"
+    N = 16
+    stub = KafkaStubBroker(partitions=2, nodes=2)
+    offsets_cfg = OffsetsConfig(policy="txn", group_id=GROUP,
+                                max_behind=None)
+    sink_cfg = SinkConfig(mode="transactional", txn_batch=4, txn_ms=30.0,
+                          offsets_group=GROUP)
+
+    class FanOut(Bolt):
+        failed_once = False
+
+        async def execute(self, t):
+            msg = t.get("message")
+            if not FanOut.failed_once and msg.endswith("-7"):
+                FanOut.failed_once = True
+                self.collector.fail(t)  # forced failure -> entry replay
+                return
+            await self.collector.emit(Values([f"{msg}/a"]), anchors=[t])
+            await self.collector.emit(Values([f"{msg}/b"]), anchors=[t])
+            self.collector.ack(t)
+
+    async def wait_out(n, timeout=60.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if stub.topic_size("soak-out") >= n:
+                return True
+            await asyncio.sleep(0.05)
+        return False
+
+    async def go():
+        FanOut.failed_once = False
+        feeder = KafkaWireBroker(f"127.0.0.1:{stub.port}",
+                                 message_format="v2")
+        # phase 1: first half (incl. the forced r-7 failure + replay)
+        for i in range(N // 2):
+            feeder.produce("soak-src", f"r-{i}", partition=i % 2)
+        broker = KafkaWireBroker(f"127.0.0.1:{stub.port}",
+                                 message_format="v2")
+        tb = TopologyBuilder()
+        tb.set_spout("in", BrokerSpout(broker, "soak-src", offsets_cfg), 1)
+        tb.set_bolt("fan", FanOut(), 1).shuffle_grouping("in")
+        tb.set_bolt("sink",
+                    TransactionalBrokerSink(broker, "soak-out", sink_cfg),
+                    1).shuffle_grouping("fan")
+        cluster = AsyncLocalCluster()
+        await cluster.submit("soak-topo", Config(), tb.build())
+        assert await wait_out(N), "phase 1 never completed"
+
+        # churn strikes with ESTABLISHED state everywhere: live producer
+        # id/epoch and sequences at the sink, cached coordinator, spout
+        # mid-group — every retry path must renegotiate, not re-create
+        stub.move_leader("soak-out", 0, 1)
+        stub.move_leader("soak-src", 1, 1)
+        stub.move_coordinator(1)
+
+        # phase 2: second half must flow THROUGH the moved cluster
+        for i in range(N // 2, N):
+            feeder.produce("soak-src", f"r-{i}", partition=i % 2)
+        assert await wait_out(2 * N), "phase 2 stalled after the moves"
+        await cluster.shutdown()
+        broker.close()
+        rc = KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2",
+                             isolation="read_committed")
+        out = []
+        for p in range(2):
+            out.extend(rc.fetch("soak-out", p, 0, max_records=200))
+        rc.close()
+        vals = sorted(r.value.decode() for r in out)
+        expect = sorted(f"r-{i}/{s}" for i in range(N) for s in "ab")
+        assert vals == expect, (len(vals), vals[:8])
+        committed = {p: feeder.committed(GROUP, "soak-src", p)
+                     for p in (0, 1)}
+        assert committed == {0: N // 2, 1: N // 2}, committed
+        feeder.close()
+
+    try:
+        run(go(), timeout=120)
+    finally:
+        stub.close()
+
+
 def test_txn_policy_orders_per_partition(run):
     """policy='txn' delivers per-partition ORDERED: while one entry's tuple
     tree is open, the spout must not fetch (let alone emit) later offsets
