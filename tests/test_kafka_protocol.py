@@ -1607,6 +1607,30 @@ def test_sasl_scram_refuses_downgraded_iteration_count():
         stub.close()
 
 
+def test_scram_auth_survives_leader_move():
+    """A leader election makes the client open a connection to a broker it
+    has never spoken to; that fresh connection must run the full SCRAM
+    exchange (multi-round-trip) before the retried produce — re-auth on
+    the retry path, not just at bootstrap."""
+    stub = KafkaStubBroker(partitions=1, nodes=2)
+    stub.sasl = ("svc", "scram-pw")
+    stub.sasl_mechanism = "SCRAM-SHA-256"
+    client = KafkaWireClient(
+        f"127.0.0.1:{stub.port}",
+        security={"protocol": "SASL_PLAINTEXT",
+                  "sasl_mechanism": "SCRAM-SHA-256",
+                  "sasl_username": "svc", "sasl_password": "scram-pw"})
+    try:
+        client.produce("t", 0, [(None, b"pre")])
+        stub.move_leader("t", 0, 1)  # node 1: never-contacted broker
+        client.produce("t", 0, [(None, b"post")])
+        recs = client.fetch("t", 0, 0, max_wait_ms=10)
+        assert [r.value for r in recs] == [b"pre", b"post"]
+    finally:
+        client.close()
+        stub.close()
+
+
 def test_sasl_scram_mechanism_mismatch_names_brokers_offer():
     """A PLAIN-only broker refusing SCRAM surfaces error 33 + the broker's
     supported list, not a hang or a silent close."""
