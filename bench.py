@@ -281,9 +281,9 @@ def sample_stats(samples) -> dict:
     even-length lists average the middle pair (taking the upper-middle
     would make a 2-sample headline equal the MAX, biasing upward exactly
     when a repeat was dropped)."""
-    s = sorted(samples)
+    s = sorted(round(x, 1) for x in samples)
     n = len(s)
-    med = s[n // 2] if n % 2 else round((s[n // 2 - 1] + s[n // 2]) / 2, 1)
+    med = round(s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2, 1)
     return {"value": med, "throughput_samples": s,
             "value_min": s[0], "value_max": s[-1]}
 
@@ -1384,10 +1384,10 @@ def _run_single_inner(args, cfg, cluster, payloads, n_dev) -> dict:
         "config": args.config,
     }
     if len(stats["throughput_samples"]) > 1:
-        result["throughput_samples"] = [
-            round(s, 1) for s in stats["throughput_samples"]]
-        result["value_min"] = round(stats["value_min"], 1)
-        result["value_max"] = round(stats["value_max"], 1)
+        # sample_stats rounds uniformly; no re-rounding here
+        result["throughput_samples"] = stats["throughput_samples"]
+        result["value_min"] = stats["value_min"]
+        result["value_max"] = stats["value_max"]
     if drain_incomplete:
         result["drain_incomplete"] = True
     if lat is not None:

@@ -696,7 +696,8 @@ class _Conn:
         # Mutual auth: a broker that doesn't hold the credentials cannot
         # produce this signature — verification is mandatory, not optional.
         expected = hm(hm(salted, b"Server Key"), auth_msg)
-        if b64(f.get("v", ""), "server signature") != expected:
+        if not hmac_mod.compare_digest(
+                b64(f.get("v", ""), "server signature"), expected):
             raise KafkaProtocolError(
                 f"SASL/{mech}: server signature mismatch (the broker does "
                 "not hold these credentials — possible man-in-the-middle)")

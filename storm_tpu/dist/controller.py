@@ -33,6 +33,33 @@ from storm_tpu.dist.transport import WorkerClient
 log = logging.getLogger("storm_tpu.dist.controller")
 
 
+def _probe_raw_spouts(cfg, builder: str) -> list:
+    """Build the recipe against a throwaway MemoryBroker and return the
+    component ids of any raw-scheme spouts. Best-effort: a custom builder
+    may inspect the broker at build time (partitions_for, wire-broker type
+    checks) and fail against the probe broker — that must not fail submit
+    for a valid topology (advice r4), so a probe failure skips the static
+    check and leaves the transport-level TypeError as the backstop."""
+    from storm_tpu.connectors import MemoryBroker
+    from storm_tpu.dist.worker import _resolve_builder
+
+    # Resolution errors (typo'd builder name) must still fail fast at
+    # submit — only the *invocation* against the probe broker is
+    # best-effort.
+    build_fn = _resolve_builder(builder)
+    try:
+        probe_topo = build_fn(cfg, MemoryBroker())
+    except Exception as exc:  # noqa: BLE001 — builder is user code
+        log.warning(
+            "raw-scheme static check skipped: builder %r could not be "
+            "probed against a MemoryBroker (%s); a raw-scheme spout "
+            "would fail at transport encode instead", builder, exc)
+        return []
+    return sorted(
+        cid for cid, spec in probe_topo.specs.items()
+        if getattr(spec.obj, "scheme", None) == "raw")
+
+
 class DistCluster:
     def __init__(
         self,
@@ -122,13 +149,7 @@ class DistCluster:
         # exactly as each worker will and inspect the REAL spout objects —
         # a config-only check cannot see raw spouts constructed by a
         # custom builder (review r4 follow-up).
-        from storm_tpu.connectors import MemoryBroker
-        from storm_tpu.dist.worker import _resolve_builder
-
-        probe_topo = _resolve_builder(builder)(cfg, MemoryBroker())
-        raw_spouts = sorted(
-            cid for cid, spec in probe_topo.specs.items()
-            if getattr(spec.obj, "scheme", None) == "raw")
+        raw_spouts = _probe_raw_spouts(cfg, builder)
         if raw_spouts:
             raise ValueError(
                 f"spout(s) {raw_spouts} use scheme='raw' (bytes tuple "

@@ -74,6 +74,27 @@ def test_raw_scheme_rejected_at_submit():
         dc.submit("t", cfg)
 
 
+def _broker_inspecting_builder(cfg, broker):
+    """A builder that (legitimately) inspects the broker at build time —
+    e.g. sizing parallelism from partitions_for on a wire broker — and so
+    cannot be probed against the throwaway MemoryBroker."""
+    raise TypeError("this builder needs a wire broker with partitions_for")
+
+
+def test_raw_probe_skips_unprobeable_builder():
+    """A builder that fails against the probe MemoryBroker must not fail
+    submit's static raw-scheme check (advice r4): the probe is best-effort
+    and the transport-level TypeError stays as the backstop."""
+    from storm_tpu.dist.controller import _probe_raw_spouts
+
+    cfg = Config()
+    cfg.topology.spout_scheme = "raw"  # invisible to a skipped probe
+    assert _probe_raw_spouts(
+        cfg, f"{__name__}:_broker_inspecting_builder") == []
+    # and the standard builder still detects it
+    assert _probe_raw_spouts(cfg, "standard") != []
+
+
 def test_raw_scheme_bytes_rejected_by_transport():
     t = Tuple(values=[b"raw-bytes"], fields=("message",),
               source_component="spout", source_task=0, stream="default",
