@@ -307,7 +307,15 @@ def main() -> int:
             mc, sc = mode_configs(mode, ckpt, name, spec["input_shape"])
             log(f"--- {name} / {mode}")
             acc_eng, engine_preds = engine_accuracy(mc, sc, x_te, y_te)
-            row = {"model": name, "mode": mode, "n_test": len(x_te),
+            # Per-row dataset label (VERDICT r4 weak #3): the model NAMES
+            # come from the bench zoo (resnet20 etc.) but the accuracy
+            # workload is the offline-available digits stand-in, stated on
+            # every row so no row can be quoted as a CIFAR-10 result.
+            row = {"model": name, "mode": mode,
+                   "dataset": f"sklearn-digits upscaled to "
+                              f"{'x'.join(map(str, spec['input_shape']))}"
+                              " (NOT cifar10)",
+                   "n_test": len(x_te),
                    "acc_float_device": round(float_acc, 4),
                    "acc_engine_device": round(acc_eng, 4),
                    "epsilon": EPSILON[mode]}

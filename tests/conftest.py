@@ -14,10 +14,19 @@ import jax
 
 # config.update, not the env var: the dev environment pins JAX_PLATFORMS to
 # the real TPU platform in a way that survives os.environ edits; tests must
-# run on the virtual 8-device CPU backend.
-jax.config.update("jax_platforms", "cpu")
-assert jax.devices()[0].platform == "cpu", "tests require the CPU backend"
-assert len(jax.devices()) == 8, "tests require 8 virtual CPU devices"
+# run on the virtual 8-device CPU backend. STORM_TPU_TEST_PLATFORM=default
+# keeps whatever jax resolves (the real chip) so the compiled-on-TPU tests
+# (tests/test_tpu_kernels.py) can run un-skipped on hardware.
+_plat = os.environ.get("STORM_TPU_TEST_PLATFORM", "cpu")
+if _plat not in ("cpu", "default"):
+    raise RuntimeError(
+        f"STORM_TPU_TEST_PLATFORM={_plat!r}: must be 'cpu' (forced 8-device "
+        "CPU mesh, the default) or 'default' (keep whatever jax resolves — "
+        "the real chip, for tests/test_tpu_kernels.py)")
+if _plat == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.devices()[0].platform == "cpu", "tests require the CPU backend"
+    assert len(jax.devices()) == 8, "tests require 8 virtual CPU devices"
 
 import asyncio
 import signal

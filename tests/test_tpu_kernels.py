@@ -1,0 +1,47 @@
+"""Compiled-on-TPU Pallas kernel parity (VERDICT r4 missing #1).
+
+These are the non-interpret twins of tests/test_ops.py's kernel checks:
+the same parity functions (storm_tpu/ops/parity_checks.py) with
+``interpret=False``, which requires Mosaic — i.e. a real TPU. Under the
+suite's forced-CPU conftest they SKIP (not pass); run them on the chip
+with ``python -m pytest tests/test_tpu_kernels.py --no-header -q -p
+no:cacheprovider`` after exporting STORM_TPU_TEST_PLATFORM=default, or
+via the artifact runner ``python tpu_kernel_parity.py`` (repo root),
+which records KERNEL_TPU_r{N}.json.
+"""
+
+import jax
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="compiled (non-interpret) Pallas kernels need a real TPU; "
+           "interpret-mode math coverage lives in tests/test_ops.py",
+)
+
+
+@pytest.mark.slow
+def test_flash_attention_compiled_parity():
+    from storm_tpu.ops.parity_checks import check_flash_attention
+
+    rows = check_flash_attention(interpret=False)
+    bad = [r for r in rows if not r["pass"]]
+    assert not bad, f"compiled flash_attention parity failures: {bad}"
+
+
+@pytest.mark.slow
+def test_fused_norm_compiled_parity():
+    from storm_tpu.ops.parity_checks import check_fused_norm
+
+    rows = check_fused_norm(interpret=False)
+    bad = [r for r in rows if not r["pass"]]
+    assert not bad, f"compiled fused_norm parity failures: {bad}"
+
+
+@pytest.mark.slow
+def test_w8a16_compiled_parity():
+    from storm_tpu.ops.parity_checks import check_w8a16
+
+    rows = check_w8a16(interpret=False)
+    bad = [r for r in rows if not r["pass"]]
+    assert not bad, f"compiled w8a16_matmul parity failures: {bad}"
