@@ -67,11 +67,20 @@ class DistCluster:
         addrs: Optional[List[str]] = None,
         env: Optional[dict] = None,
         worker_resources: Optional[dict] = None,
+        auth_token: Optional[str] = None,
     ) -> None:
         """Spawn ``n_workers`` local worker processes, or attach to
         ``addrs`` (["host:port", ...]) if given. ``worker_resources``
         is each worker's capacity for resource-aware placement
-        (default {"memory_mb": 4096, "cpu": 400})."""
+        (default {"memory_mb": 4096, "cpu": 400}). ``auth_token``
+        (default: $STORM_TPU_CONTROL_TOKEN) is the shared control-plane
+        secret: exported to spawned workers and attached to every RPC;
+        workers reject token-less/mismatched calls (config
+        ``control.auth_token``)."""
+        from storm_tpu.dist.transport import TOKEN_ENV, _env_token
+
+        self._token = _env_token() if auth_token is None else auth_token
+        self._token_env = TOKEN_ENV
         self._worker_resources = worker_resources or {
             "memory_mb": 4096.0, "cpu": 400.0}
         self.procs: List[Optional[subprocess.Popen]] = []
@@ -89,7 +98,7 @@ class DistCluster:
         self._closing = False
         if addrs:
             for addr in addrs:
-                self.clients.append(WorkerClient(addr))
+                self.clients.append(WorkerClient(addr, token=self._token))
         else:
             for i in range(n_workers):
                 proc, client = self._spawn_worker(i)
@@ -117,7 +126,12 @@ class DistCluster:
              "--port", "0", "--index", str(index)],
             stdout=subprocess.PIPE,
             stderr=errf,
-            env={**os.environ, **(self._env or {})},
+            # Always pin the token var — including to "" when auth is
+            # disabled — so a stale export in the operator's shell can't
+            # make workers enforce a token the controller won't send
+            # (review r5).
+            env={**os.environ, **(self._env or {}),
+                 self._token_env: self._token},
         )
         # Worker prints one JSON ready-line with its bound port.
         line = proc.stdout.readline().decode()
@@ -128,7 +142,8 @@ class DistCluster:
                 f"worker {index} died during startup; stderr tail:\n{tail}"
             )
         info = json.loads(line)
-        return proc, WorkerClient(f"127.0.0.1:{info['port']}")
+        return proc, WorkerClient(f"127.0.0.1:{info['port']}",
+                                  token=self._token)
 
     # ---- topology lifecycle --------------------------------------------------
 

@@ -197,6 +197,8 @@ class DistClusterView:
 
 
 async def start_dist_ui(dist, name: str, port: int = 0,
-                        host: str = "127.0.0.1") -> UIServer:
+                        host: str = "127.0.0.1",
+                        auth_token: str = "") -> UIServer:
     """Serve the Storm-UI HTTP API for a running DistCluster topology."""
-    return await UIServer(DistClusterView(dist, name), host=host, port=port).start()
+    return await UIServer(DistClusterView(dist, name), host=host, port=port,
+                          auth_token=auth_token).start()

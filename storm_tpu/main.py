@@ -201,7 +201,8 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
 
         # remote submission gets the daemon's broker as $broker
         ui = await UIServer(cluster, port=ui_port,
-                            resources={"broker": broker}).start()
+                            resources={"broker": broker},
+                            auth_token=cfg.control.resolve_token()).start()
     print(f"topology {name!r} running "
           f"(model={desc}, broker={cfg.broker.kind}"
           f"{', autoscaling' if scalers else ''}"
@@ -230,17 +231,26 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
 
 def _ctl(args) -> int:
     """Drive a running daemon's UI HTTP API from the command line."""
+    import os
     import urllib.error
     import urllib.parse
     import urllib.request
 
     base = args.url.rstrip("/")
     topo = urllib.parse.quote(getattr(args, "topology", ""), safe="")
+    # Admin auth (control.auth_token on the daemon): --token wins, else
+    # the control-plane env var (shared with the dist controller).
+    from storm_tpu.config import CONTROL_TOKEN_ENV
+
+    token = (getattr(args, "token", None)
+             or os.environ.get(CONTROL_TOKEN_ENV, ""))
 
     def call(method, path, body=None, timeout=30, headers=None):
         req = urllib.request.Request(
             base + path, method=method,
             data=json.dumps(body).encode() if body is not None else None)
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
         for k, v in (headers or {}).items():
             req.add_header(k, v)
         try:
@@ -403,6 +413,10 @@ def main(argv=None) -> int:
                     "(the storm kill/activate/deactivate/rebalance CLI)")
     ctlp.add_argument("--url", default="http://127.0.0.1:8080",
                       help="base URL of the daemon's --ui-port server")
+    ctlp.add_argument("--token", default=None,
+                      help="bearer token for daemons running with "
+                           "control.auth_token (default: "
+                           "$STORM_TPU_CONTROL_TOKEN)")
     ctlsub = ctlp.add_subparsers(dest="ctl_cmd", required=True)
     for cmd in ("list", "status", "metrics", "graph", "errors"):
         c = ctlsub.add_parser(cmd)
@@ -496,8 +510,19 @@ def main(argv=None) -> int:
         from storm_tpu.dist import DistCluster
 
         builder = "multi" if cfg.pipelines else "standard"
+        # One resolution for BOTH the gRPC plane and the dist UI: config
+        # wins, else the env var — the UI must never stay open in a
+        # posture where the workers think the cluster is locked (review
+        # r5).
+        import os as _os
+
+        from storm_tpu.config import CONTROL_TOKEN_ENV
+
+        control_token = (cfg.control.resolve_token()
+                         or _os.environ.get(CONTROL_TOKEN_ENV, ""))
         with DistCluster(
-            n_workers=args.workers, addrs=args.attach or None
+            n_workers=args.workers, addrs=args.attach or None,
+            auth_token=control_token,
         ) as cluster:
             placement = cluster.submit(args.name, cfg, builder=builder)
             print(f"topology {args.name!r} across {len(cluster.clients)} "
@@ -514,7 +539,9 @@ def main(argv=None) -> int:
                 ui_loop = asyncio.new_event_loop()
                 threading.Thread(target=ui_loop.run_forever, daemon=True).start()
                 ui = asyncio.run_coroutine_threadsafe(
-                    start_dist_ui(cluster, args.name, args.ui_port), ui_loop
+                    start_dist_ui(cluster, args.name, args.ui_port,
+                                  auth_token=control_token),
+                    ui_loop,
                 ).result(timeout=10)
                 print(f"ui http://127.0.0.1:{ui.port}", file=sys.stderr)
             try:

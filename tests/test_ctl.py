@@ -204,3 +204,44 @@ def test_remote_submit_flux_topology(run):
             await cluster.shutdown()
 
     run(go(), timeout=120)
+
+
+def test_ctl_token_flag(run):
+    """`ctl --token` sends the bearer header the auth-enabled daemon
+    demands; without it, mutating commands come back 401."""
+
+    async def go():
+        from storm_tpu.runtime import TopologyBuilder
+
+        tb = TopologyBuilder()
+        tb.set_spout("spout", TrickleSpout(), parallelism=1)
+        tb.set_bolt("echo", EchoBolt(), parallelism=1).shuffle_grouping("spout")
+        cluster = AsyncLocalCluster()
+        await cluster.submit("demo", Config(), tb.build())
+        ui = await UIServer(cluster, port=0, auth_token="ops-tok").start()
+        url = f"http://127.0.0.1:{ui.port}"
+        loop = asyncio.get_running_loop()
+        try:
+            # read works without a token
+            rc, out = await loop.run_in_executor(
+                None, _ctl, url, "status", "demo")
+            assert rc == 0
+            # mutating without the token: nonzero rc, 401 surfaced
+            rc, out = await loop.run_in_executor(
+                None, _ctl, url, "deactivate", "demo")
+            assert rc != 0 and "token" in out
+            # with --token: accepted
+            def ctl_tok():
+                buf = io.StringIO()
+                with redirect_stdout(buf):
+                    rc = cli_main(["ctl", "--url", url, "--token", "ops-tok",
+                                   "deactivate", "demo"])
+                return rc, buf.getvalue()
+
+            rc, out = await loop.run_in_executor(None, ctl_tok)
+            assert rc == 0 and json.loads(out)["status"] == "INACTIVE"
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)

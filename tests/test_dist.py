@@ -135,7 +135,11 @@ def test_dist_three_workers_end_to_end():
         }
         n_msgs = 12
         rng = np.random.RandomState(0)
-        with DistCluster(3, env={"JAX_PLATFORMS": "cpu", "STORM_TPU_PLATFORM": "cpu"}) as cluster:
+        # auth_token on the full e2e: proves worker->worker Deliver/Ack
+        # (peer clients read STORM_TPU_CONTROL_TOKEN from the spawn env)
+        # carries the token under real traffic, not just Control pings.
+        with DistCluster(3, env={"JAX_PLATFORMS": "cpu", "STORM_TPU_PLATFORM": "cpu"},
+                         auth_token="e2e-secret") as cluster:
             used = cluster.submit("dist-e2e", cfg, placement)
             assert used == placement
 
@@ -638,3 +642,52 @@ def test_multiprocess_serving():
         # and they match the single-process run of the same global mesh
         ref = run_procs(1, mode, env_ref)
         assert two[0] == ref[0], (mode, two[0], ref[0])
+
+
+def test_dist_control_plane_auth():
+    """Shared-secret control-plane auth (VERDICT r4 missing #4): a
+    DistCluster spawned with auth_token attaches it to every RPC (workers
+    inherit it via STORM_TPU_CONTROL_TOKEN), and a worker rejects
+    token-less and wrong-token callers as UNAUTHENTICATED on Control AND
+    the Deliver data path."""
+    import grpc
+
+    from storm_tpu.dist import DistCluster
+
+    with DistCluster(1, env={"JAX_PLATFORMS": "cpu",
+                             "STORM_TPU_PLATFORM": "cpu"},
+                     auth_token="cluster-secret") as cluster:
+        target = cluster.clients[0].target
+        # the controller's own token-carrying client works (wait_ready in
+        # __init__ already proved it; ping again explicitly)
+        cluster.clients[0].control("ping")
+        for bad in ("", "wrong-secret"):
+            rogue = transport.WorkerClient(target, token=bad)
+            try:
+                with pytest.raises(grpc.RpcError) as ei:
+                    rogue.control("ping")
+                assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+                with pytest.raises(grpc.RpcError) as ei:
+                    rogue.deliver(transport.encode_deliveries([]))
+                assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+            finally:
+                rogue.close()
+        # right token, fresh client: accepted
+        ok = transport.WorkerClient(target, token="cluster-secret")
+        try:
+            ok.control("ping")
+        finally:
+            ok.close()
+
+    # auth explicitly disabled + a stale token export in the spawning
+    # shell: the controller pins the env var to "" for its workers, so
+    # startup must not deadlock on workers enforcing a token the
+    # controller won't send (review r5).
+    os.environ[transport.TOKEN_ENV] = "stale-from-previous-cluster"
+    try:
+        with DistCluster(1, env={"JAX_PLATFORMS": "cpu",
+                                 "STORM_TPU_PLATFORM": "cpu"},
+                         auth_token="") as cluster:
+            cluster.clients[0].control("ping")
+    finally:
+        del os.environ[transport.TOKEN_ENV]
