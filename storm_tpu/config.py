@@ -362,6 +362,14 @@ def _apply_section(target, values: dict) -> None:
 CONTROL_TOKEN_ENV = "STORM_TPU_CONTROL_TOKEN"
 
 
+def env_control_token() -> str:
+    """The ONE env-fallback read shared by the UI, dist plane, and ctl —
+    resolution must never diverge between the binary's serving modes."""
+    import os
+
+    return os.environ.get(CONTROL_TOKEN_ENV, "")
+
+
 @dataclass
 class ControlConfig:
     """Control-plane authentication (VERDICT r4 missing #4).
@@ -374,11 +382,13 @@ class ControlConfig:
 
     ``auth_token`` is a shared secret: requests must carry it
     (``Authorization: Bearer <token>`` on HTTP, ``x-storm-tpu-token``
-    gRPC metadata), mismatches are rejected and logged. ``""`` disables
-    auth (loopback-dev posture, the previous behavior). ``"env:NAME"``
+    gRPC metadata), mismatches are rejected and logged. ``"env:NAME"``
     reads the secret from environment variable NAME so it never lives in
-    a config file. The dist controller exports the resolved token to its
-    spawned workers via STORM_TPU_CONTROL_TOKEN."""
+    a config file. ``""`` (the default) falls back to
+    $STORM_TPU_CONTROL_TOKEN — one posture for the UI, the dist gRPC
+    plane, and ctl alike — and disables auth only when that is also
+    unset (loopback-dev, the previous behavior). The dist controller
+    exports the resolved token to its spawned workers via the same var."""
 
     auth_token: str = ""
 
@@ -393,7 +403,7 @@ class ControlConfig:
                 raise ValueError(
                     f"control.auth_token says {t!r} but ${name} is unset/empty")
             return val
-        return t
+        return t or env_control_token()
 
 
 @dataclass

@@ -35,6 +35,7 @@ SERVICE = "storm_tpu.Dist"
 #: controller exports this env var to its workers, every RPC carries the
 #: token as metadata, and workers reject mismatches as UNAUTHENTICATED.
 from storm_tpu.config import CONTROL_TOKEN_ENV as TOKEN_ENV
+from storm_tpu.config import env_control_token as _env_token
 
 _TOKEN_MD_KEY = "x-storm-tpu-token"
 
@@ -42,12 +43,6 @@ _OPTS = [
     ("grpc.max_receive_message_length", 64 * 1024 * 1024),
     ("grpc.max_send_message_length", 64 * 1024 * 1024),
 ]
-
-
-def _env_token() -> str:
-    import os
-
-    return os.environ.get(TOKEN_ENV, "")
 
 
 # ---- tuple envelope ----------------------------------------------------------
@@ -210,7 +205,10 @@ class DistHandler(grpc.GenericRpcHandler):
 
         def wrapped(request, context):
             md = dict(context.invocation_metadata() or ())
-            if not hmac.compare_digest(md.get(_TOKEN_MD_KEY, ""), token):
+            got = md.get(_TOKEN_MD_KEY, "")
+            if isinstance(got, str):  # bytes: compare_digest rejects
+                got = got.encode("utf-8", "surrogateescape")  # non-ASCII str
+            if not hmac.compare_digest(got, token.encode("utf-8")):
                 peer = context.peer()
                 log.warning("rejected unauthenticated %s from %s",
                             method, peer)

@@ -239,11 +239,10 @@ def _ctl(args) -> int:
     base = args.url.rstrip("/")
     topo = urllib.parse.quote(getattr(args, "topology", ""), safe="")
     # Admin auth (control.auth_token on the daemon): --token wins, else
-    # the control-plane env var (shared with the dist controller).
-    from storm_tpu.config import CONTROL_TOKEN_ENV
+    # the shared control-plane env fallback.
+    from storm_tpu.config import env_control_token
 
-    token = (getattr(args, "token", None)
-             or os.environ.get(CONTROL_TOKEN_ENV, ""))
+    token = getattr(args, "token", None) or env_control_token()
 
     def call(method, path, body=None, timeout=30, headers=None):
         req = urllib.request.Request(
@@ -510,16 +509,11 @@ def main(argv=None) -> int:
         from storm_tpu.dist import DistCluster
 
         builder = "multi" if cfg.pipelines else "standard"
-        # One resolution for BOTH the gRPC plane and the dist UI: config
-        # wins, else the env var — the UI must never stay open in a
-        # posture where the workers think the cluster is locked (review
-        # r5).
-        import os as _os
-
-        from storm_tpu.config import CONTROL_TOKEN_ENV
-
-        control_token = (cfg.control.resolve_token()
-                         or _os.environ.get(CONTROL_TOKEN_ENV, ""))
+        # One resolution for BOTH the gRPC plane and the dist UI (config
+        # wins, else the shared env fallback inside resolve_token) — the
+        # UI must never stay open in a posture where the workers think
+        # the cluster is locked (review r5).
+        control_token = cfg.control.resolve_token()
         with DistCluster(
             n_workers=args.workers, addrs=args.attach or None,
             auth_token=control_token,

