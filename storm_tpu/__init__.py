@@ -24,7 +24,7 @@ Public surface::
     from storm_tpu.connectors import BrokerSpout, BrokerSink, MemoryBroker
 """
 
-__version__ = "0.1.0"
+__version__ = "1.0.0"
 
 from storm_tpu.config import Config, TopologyConfig, ModelConfig, BatchConfig
 from storm_tpu.runtime.topology import TopologyBuilder
