@@ -1,0 +1,387 @@
+#!/usr/bin/env python
+"""Full-surface production soak (VERDICT r4 missing #2 / next-round #2).
+
+Every production subsystem AT ONCE, for >= 10 minutes, on the real chip:
+
+  - transport: SASL_SSL (TLS + SCRAM-SHA-256) to a 2-node wire-protocol
+    stub broker — every connection in the run is encrypted+authenticated;
+  - delivery: end-to-end exactly-once (offsets.policy='txn' spout +
+    whole-tree transactional sink committing consumed offsets inside the
+    producer transaction, read_committed audit);
+  - churn: periodic LEADER moves and COORDINATOR moves while transactions
+    and group state are live;
+  - elasticity: one live rebalance (prewarmed replica) mid-run;
+  - ops: one live model swap mid-run (engine rebuild under traffic);
+  - failure: chaos kills of the inference and echo executors (tree replay
+    through the exactly-once machinery);
+  - the real device path: trained LeNet-5 serving on jax.devices()[0].
+
+Topology (product components, unmodified):
+
+    spout(txn) ──> infer(InferenceBolt, real chip) ──┐
+         │                                           ├──> txn sink ──> soak-out
+         └──> echo(identity: sha256 of the record) ──┘
+                                 infer dead_letter ────> dlq sink ──> soak-dlq
+
+Each input record's tuple tree = {1 prediction + 1 echo}; the sink parks
+the whole tree and commits it with the record's offset in ONE transaction.
+The audit (read_committed) then proves, for EVERY consumed offset:
+  - its echo hash appears EXACTLY once (identity-level exactly-once —
+    catches loss+dupe pairs that count-based audits cancel out);
+  - prediction count == input count, every prediction a valid softmax row
+    (tree atomicity extends the echo lane's exactly-once to the
+    prediction lane);
+  - committed group offsets cover the whole input log;
+  - zero dead-letters.
+Any violation is a release blocker (exit 1). Reference analog: the
+1-hour run-and-watch integration test (MainTopology.java:69-77) — this
+is shorter but audited, not watched.
+
+Run (real chip):  python soak_harness.py --seconds 660 --rate 30
+CPU smoke:        STORM_TPU_PLATFORM=cpu python soak_harness.py \
+                      --seconds 60 --rate 20 --out -
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+GROUP = "soak-group"
+IN, OUT, DLQ = "soak-in", "soak-out", "soak-dlq"
+
+
+def log(msg: str) -> None:
+    print(f"[soak] {msg}", file=sys.stderr, flush=True)
+
+
+def make_certs(d: str):
+    crt, key = os.path.join(d, "broker.crt"), os.path.join(d, "broker.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", "2", "-subj",
+         "/CN=127.0.0.1", "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return crt, key
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=660.0,
+                    help="feed duration (events are scheduled across it)")
+    ap.add_argument("--rate", type=float, default=30.0, help="records/sec")
+    ap.add_argument("--out", default="SOAK_r05.json")
+    ap.add_argument("--slo-ms", type=float, default=1000.0,
+                    help="per-window sink p50 target for the SLO timeline")
+    args = ap.parse_args()
+
+    plat = os.environ.get("STORM_TPU_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    import jax
+
+    device = jax.devices()[0]
+    log(f"device: {device.device_kind} ({device.platform})")
+
+    import ssl
+
+    from tests.kafka_stub import KafkaStubBroker
+
+    from storm_tpu.config import (BatchConfig, Config, ModelConfig,
+                                  OffsetsConfig, ShardingConfig, SinkConfig)
+    from storm_tpu.connectors import BrokerSink, BrokerSpout, \
+        TransactionalBrokerSink
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+    from storm_tpu.infer import InferenceBolt
+    from storm_tpu.runtime import Bolt, TopologyBuilder, Values
+    from storm_tpu.runtime.chaos import ChaosMonkey
+    from storm_tpu.runtime.cluster import LocalCluster
+
+    tmp = tempfile.mkdtemp(prefix="soak-certs-")
+    crt, key = make_certs(tmp)
+    P = 16  # txn policy gates ONE open tree per partition; the tunneled
+    # device RTT (~0.3 s) makes per-partition tree rate ~3/s, so the
+    # partition count IS the in-flight parallelism of the soak
+    stub = KafkaStubBroker(partitions=P, nodes=2)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(crt, key)
+    stub.ssl_context = ctx
+    stub.sasl = ("soak-svc", "soak-pw")
+    stub.sasl_mechanism = "SCRAM-SHA-256"
+    security = {"protocol": "SASL_SSL", "sasl_mechanism": "SCRAM-SHA-256",
+                "sasl_username": "soak-svc", "sasl_password": "soak-pw",
+                "ssl_cafile": crt, "ssl_check_hostname": False}
+
+    def wire():
+        return KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2",
+                               security=security)
+
+    class EchoBolt(Bolt):
+        """Identity lane: the record's content hash, anchored to the same
+        tree as its prediction, so the transactional sink commits both
+        (or neither) with the offset."""
+
+        async def execute(self, t):
+            h = hashlib.sha256(t.get("message").encode()).hexdigest()[:24]
+            await self.collector.emit(Values([f"h:{h}"]), anchors=[t])
+            self.collector.ack(t)
+
+    ckpt = os.path.join(REPO, "checkpoints", "lenet5_digits")
+    model_cfg = ModelConfig(name="lenet5", checkpoint=ckpt,
+                            input_shape=(32, 32, 1), num_classes=10)
+    batch_cfg = BatchConfig(max_batch=64, max_wait_ms=20.0, buckets=(8, 64),
+                            max_inflight=2)
+    run_cfg = Config()
+    run_cfg.topology.message_timeout_s = 120.0
+
+    broker = wire()
+    tb = TopologyBuilder()
+    tb.set_spout(
+        "spout",
+        BrokerSpout(broker, IN,
+                    OffsetsConfig(policy="txn", group_id=GROUP,
+                                  max_behind=None)),
+        parallelism=1)
+    tb.set_bolt("infer",
+                InferenceBolt(model_cfg, batch_cfg,
+                              ShardingConfig(data_parallel=0)),
+                parallelism=1).shuffle_grouping("spout")
+    tb.set_bolt("echo", EchoBolt(), parallelism=1).shuffle_grouping("spout")
+    tb.set_bolt(
+        "sink",
+        TransactionalBrokerSink(
+            broker, OUT,
+            SinkConfig(mode="transactional", txn_batch=64, txn_ms=250.0,
+                       offsets_group=GROUP)),
+        parallelism=1)\
+        .shuffle_grouping("infer").shuffle_grouping("echo")
+    tb.set_bolt("dlq", BrokerSink(broker, DLQ, run_cfg.sink), parallelism=1)\
+        .shuffle_grouping("infer", stream="dead_letter")
+
+    rng = np.random.RandomState(7)
+    produced_hashes = []
+    feeder = wire()
+    stop_feed = threading.Event()
+    fed = [0]
+
+    def feed():
+        interval = 1.0 / args.rate
+        nxt = time.perf_counter()
+        while not stop_feed.is_set():
+            now = time.perf_counter()
+            if now < nxt:
+                time.sleep(min(0.01, nxt - now))
+                continue
+            payload = json.dumps(
+                {"instances": rng.rand(1, 32, 32, 1).round(4).tolist()})
+            produced_hashes.append(
+                hashlib.sha256(payload.encode()).hexdigest()[:24])
+            feeder.produce(IN, payload, partition=fed[0] % P)
+            fed[0] += 1
+            nxt += interval
+
+    events = []  # (t_s, name, detail)
+    timeline = []  # (t_s, sink_p50_ms, windows' delivered count)
+
+    def mark(name, detail=""):
+        events.append((round(time.perf_counter() - t0, 1), name, detail))
+        log(f"EVENT {name} {detail}")
+
+    cluster = LocalCluster()
+    t0 = time.perf_counter()
+    try:
+        cluster.submit_topology("soak", run_cfg, tb.build())
+        log("topology up; starting feed")
+
+        rt = None
+
+        async def _rt():
+            return cluster._cluster.runtime("soak")
+
+        rt = cluster._run(_rt())
+        chaos = ChaosMonkey(rt)
+
+        feeder_thread = threading.Thread(target=feed, daemon=True)
+        feeder_thread.start()
+
+        # events spread across the run (fractions of --seconds)
+        dur = args.seconds
+        plan = [
+            (0.10, "move_leader", lambda: stub.move_leader(OUT, 0, 1)),
+            (0.20, "move_coordinator", lambda: stub.move_coordinator(1)),
+            (0.30, "chaos_kill_infer", lambda: chaos.crash_bolt("infer", 0)),
+            (0.40, "rebalance_infer_2",
+             lambda: cluster._run(rt.rebalance("infer", 2))),
+            (0.55, "swap_model_f32",
+             lambda: cluster._run(rt.swap_model(
+                 "infer", {"dtype": "float32"}))),
+            (0.70, "move_leader_in", lambda: stub.move_leader(IN, 1, 0)),
+            (0.78, "chaos_kill_echo", lambda: chaos.crash_bolt("echo", 0)),
+            (0.86, "move_coordinator_back",
+             lambda: stub.move_coordinator(0)),
+            (0.93, "chaos_kill_infer_2",
+             lambda: chaos.crash_bolt("infer", 1)),
+        ]
+        next_plan = 0
+        window_s = 10.0
+        next_window = time.perf_counter() + window_s
+        end = time.perf_counter() + dur
+        last_out = 0
+        while time.perf_counter() < end:
+            now = time.perf_counter()
+            frac = (now - t0) / dur
+            if next_plan < len(plan) and frac >= plan[next_plan][0]:
+                name = plan[next_plan][1]
+                try:
+                    plan[next_plan][2]()
+                    mark(name)
+                except Exception as e:  # an event must not end the soak
+                    mark(name + "_FAILED", repr(e))
+                next_plan += 1
+            if now >= next_window:
+                next_window = now + window_s
+                lat = cluster.metrics("soak")["sink"]["e2e_latency_ms"]
+                p50 = lat["p50"]
+                cluster.reset_histogram("soak", "sink", "e2e_latency_ms")
+                out_n = stub.topic_size(OUT)
+                timeline.append((round(now - t0, 1),
+                                 None if p50 is None else round(p50, 1),
+                                 out_n - last_out))
+                last_out = out_n
+                log(f"t={now - t0:6.1f}s p50="
+                    f"{'stalled' if p50 is None else f'{p50:.0f}ms'} "
+                    f"out+={timeline[-1][2]} fed={fed[0]}")
+            time.sleep(0.2)
+
+        stop_feed.set()
+        feeder_thread.join(timeout=10)
+        n = fed[0]
+        log(f"feed done: {n} records; draining")
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if stub.topic_size(OUT) >= 2 * n:
+                break
+            time.sleep(0.5)
+        drained = stub.topic_size(OUT) >= 2 * n
+        log(f"drained={drained} out={stub.topic_size(OUT)}/{2 * n}")
+    finally:
+        try:
+            cluster.shutdown()
+        except Exception as e:
+            log(f"shutdown: {e!r}")
+
+    # ---- audit (read_committed) ---------------------------------------------
+    n = fed[0]
+    rc = KafkaWireBroker(f"127.0.0.1:{stub.port}", message_format="v2",
+                         isolation="read_committed", security=security)
+    out_records = []
+    for p in range(P):
+        off = 0
+        while True:
+            batch = rc.fetch(OUT, p, off, max_records=2000)
+            if not batch:
+                break
+            out_records.extend(batch)
+            off = batch[-1].offset + 1
+    committed = {p: feeder.committed(GROUP, IN, p) for p in range(P)}
+    produced_per_part = {p: (n - p + P - 1) // P for p in range(P)}
+    dlq_n = stub.topic_size(DLQ)
+    rc.close()
+    feeder.close()
+    broker.close()
+    stub.close()
+
+    echoes, preds, bad_preds = [], 0, 0
+    for r in out_records:
+        v = r.value.decode()
+        if v.startswith("h:"):
+            echoes.append(v[2:])
+        else:
+            preds += 1
+            try:
+                row = json.loads(v)["predictions"][0]
+                if len(row) != 10 or abs(sum(row) - 1.0) > 1e-2:
+                    bad_preds += 1
+            except Exception:
+                bad_preds += 1
+
+    from collections import Counter
+
+    want, got = Counter(produced_hashes), Counter(echoes)
+    missing = sum((want - got).values())
+    duplicated = sum((got - want).values())
+    offsets_ok = committed == produced_per_part
+    stalled_windows = sum(1 for w in timeline if w[1] is None and w[2] == 0)
+    p50s = [w[1] for w in timeline if w[1] is not None]
+    met = [p for p in p50s if p <= args.slo_ms]
+
+    exactly_once = (missing == 0 and duplicated == 0 and preds == n
+                    and bad_preds == 0 and offsets_ok and dlq_n == 0
+                    and drained)
+    artifact = {
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+        "duration_s": round(args.seconds, 1),
+        "offered_rate_msg_s": args.rate,
+        "records_in": n,
+        "records_out": len(out_records),
+        "transport": "SASL_SSL + SCRAM-SHA-256 (2-node stub, "
+                     "wire protocol over TLS sockets)",
+        "exactly_once": exactly_once,
+        "audit": {
+            "echo_missing": missing,
+            "echo_duplicated": duplicated,
+            "predictions": preds,
+            "predictions_expected": n,
+            "invalid_predictions": bad_preds,
+            "committed_offsets": committed,
+            "committed_offsets_expected": produced_per_part,
+            "dead_letters": dlq_n,
+            "drained": drained,
+        },
+        "slo": {
+            "target_p50_ms": args.slo_ms,
+            "windows_met": f"{len(met)}/{len(p50s)}",
+            "stalled_windows": stalled_windows,
+            "worst_window_p50_ms": max(p50s, default=None),
+            "median_window_p50_ms": (sorted(p50s)[len(p50s) // 2]
+                                     if p50s else None),
+        },
+        "events": events,
+        "timeline": timeline,
+        "note": "echo lane = sha256 of each record, committed in the SAME "
+                "transaction (same tuple tree) as its prediction and its "
+                "offset; identity-level exactly-once on the echo lane + "
+                "tree atomicity + count equality extends the proof to the "
+                "prediction lane (the product wire contract carries no "
+                "correlation id, reference parity)",
+    }
+    out = json.dumps(artifact, indent=1)
+    if args.out == "-":
+        print(out)
+    else:
+        with open(os.path.join(REPO, args.out), "w") as f:
+            f.write(out + "\n")
+        log(f"wrote {args.out}")
+    log(f"exactly_once={exactly_once} "
+        f"(missing={missing} dup={duplicated} preds={preds}/{n} "
+        f"bad={bad_preds} offsets_ok={offsets_ok} dlq={dlq_n})")
+    return 0 if exactly_once else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
