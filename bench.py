@@ -296,6 +296,32 @@ def _new_capture_session() -> str:
     return "cap-" + time.strftime("%Y%m%dT%H%M%S")
 
 
+def _code_version() -> str:
+    """Code identity stamped into every artifact: the git commit (plus
+    ``-dirty`` when the worktree has uncommitted changes), falling back to
+    "unknown" outside a git checkout. Same-code pooling decisions key on
+    this, not on the calendar day — two sessions hours apart on the same
+    commit measured the same code; two minutes apart across a commit did
+    not."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"], cwd=repo,
+            capture_output=True, text=True, timeout=10)
+        if rev.returncode != 0:
+            return "unknown"
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=repo,
+            capture_output=True, text=True, timeout=10)
+        suffix = "-dirty" if dirty.returncode == 0 and dirty.stdout.strip() \
+            else ""
+        return rev.stdout.strip() + suffix
+    except (OSError, subprocess.TimeoutExpired):
+        return "unknown"
+
+
 def _latest_artifact(pattern: str):
     """(filename, parsed-artifact) for the newest committed BENCH file
     matching ``pattern`` (by round number in the name), or None. Driver
@@ -364,12 +390,17 @@ def pool_headline_into_matrix(rows: list) -> None:
     name, art = ref
     if not isinstance(art, dict):
         return
-    # Same-code-era guard: only pool headlines that carry a
-    # capture_session from the SAME calendar day — pooling a previous
-    # round's samples (measured on different code) would present a
-    # cross-version blend as one best estimate (review r5).
-    session = art.get("capture_session") or ""
-    if not session.startswith("cap-" + time.strftime("%Y%m%d")):
+    # Same-code-era guard: only pool headlines measured on the SAME git
+    # commit as this run — pooling samples from different code would
+    # present a cross-version blend as one best estimate. The commit
+    # stamp replaces the earlier same-calendar-day heuristic (review r5),
+    # which both over-pooled (same day, different commit) and
+    # under-pooled (same commit, measured past midnight). Unstamped
+    # legacy artifacts and dirty/unknown worktrees never pool.
+    ours = _code_version()
+    theirs = art.get("code_version") or ""
+    if (not theirs or theirs != ours or "dirty" in ours
+            or ours == "unknown"):
         return
     headline_samples = art.get("throughput_samples") or (
         [art["value"]] if "value" in art else [])
@@ -383,6 +414,7 @@ def pool_headline_into_matrix(rows: list) -> None:
     row["pooled_from"] = {
         "file": name,
         "capture_session": art.get("capture_session"),
+        "code_version": theirs,
         "headline_samples": headline_samples,
         "note": "pooled median below supersedes both artifacts' "
                 "individual medians as the best estimate for this config",
@@ -1619,12 +1651,14 @@ def main() -> None:
         headline_ref = _latest_artifact("BENCH_r*.json")
         print(json.dumps({
             "capture_session": _new_capture_session(),
+            "code_version": _code_version(),
             "see_also": headline_ref[0] if headline_ref else None,
             "rows": results,
         }))
         return
     result = run_multi(args) if args.config == "multi" else run_single(args)
     result["capture_session"] = _new_capture_session()
+    result["code_version"] = _code_version()
     cross_reference_headline(result)
     print(json.dumps(result))
 

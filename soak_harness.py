@@ -269,6 +269,14 @@ def main() -> int:
 
         stop_feed.set()
         feeder_thread.join(timeout=10)
+        # A feeder still alive past the join timeout is wedged mid-produce:
+        # fed[0] may keep moving under the audit below, so the exactly-once
+        # accounting would compare against a moving target. Flag it and
+        # fail the run rather than report a vacuous pass.
+        feeder_stuck = feeder_thread.is_alive()
+        if feeder_stuck:
+            log("WARNING: feeder thread still alive after join timeout; "
+                "exactly-once accounting is unreliable")
         n = fed[0]
         log(f"feed done: {n} records; draining")
         deadline = time.time() + 300
@@ -331,7 +339,7 @@ def main() -> int:
 
     exactly_once = (missing == 0 and duplicated == 0 and preds == n
                     and bad_preds == 0 and offsets_ok and dlq_n == 0
-                    and drained)
+                    and drained and not feeder_stuck)
     artifact = {
         "platform": device.platform,
         "device_kind": device.device_kind,
@@ -352,6 +360,7 @@ def main() -> int:
             "committed_offsets_expected": produced_per_part,
             "dead_letters": dlq_n,
             "drained": drained,
+            "feeder_stuck": feeder_stuck,
         },
         "slo": {
             "target_p50_ms": args.slo_ms,
@@ -379,7 +388,8 @@ def main() -> int:
         log(f"wrote {args.out}")
     log(f"exactly_once={exactly_once} "
         f"(missing={missing} dup={duplicated} preds={preds}/{n} "
-        f"bad={bad_preds} offsets_ok={offsets_ok} dlq={dlq_n})")
+        f"bad={bad_preds} offsets_ok={offsets_ok} dlq={dlq_n} "
+        f"feeder_stuck={feeder_stuck})")
     return 0 if exactly_once else 1
 
 

@@ -407,6 +407,35 @@ class ControlConfig:
 
 
 @dataclass
+class TracingConfig:
+    """Per-record distributed tracing + flight recorder (runtime/tracing.py).
+
+    Off by default: ``sample_rate=0`` keeps the hot path allocation-free
+    (sampled context objects are only minted for sampled roots)."""
+
+    # Fraction of root tuples that carry a TraceContext (0 = off, 1 = all).
+    sample_rate: float = 0.0
+    # Completed traces kept in the in-process ring buffer (per process).
+    store_capacity: int = 256
+    # e2e latency above which the sink logs a flight-recorder SLO-breach
+    # event (0 = disabled).
+    slo_ms: float = 0.0
+    # JSONL flight-recorder file ("" = in-memory ring only).
+    flight_path: str = ""
+    # In-memory flight-recorder ring size (events).
+    flight_capacity: int = 512
+    # Rotation: roll flight_path -> .1 -> ... when it exceeds this size,
+    # keeping at most flight_max_files generations.
+    flight_max_bytes: int = 4 * 1024 * 1024
+    flight_max_files: int = 3
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= float(self.sample_rate) <= 1.0:
+            raise ValueError(
+                f"tracing.sample_rate must be in [0, 1], got {self.sample_rate!r}")
+
+
+@dataclass
 class PipelineConfig:
     """One model pipeline (spout -> inference -> sink) inside a multi-model
     topology: several of these share one process and one TPU slice
@@ -455,6 +484,7 @@ class Config:
     sink: SinkConfig = field(default_factory=SinkConfig)
     broker: BrokerConfig = field(default_factory=BrokerConfig)
     control: ControlConfig = field(default_factory=ControlConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
     # Multi-model topology: non-empty => ``run`` builds one spout->infer->sink
     # chain per entry instead of the single-model DAG. TOML: [[pipelines]].
     pipelines: list = field(default_factory=list)

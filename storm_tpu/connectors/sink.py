@@ -116,6 +116,12 @@ class BrokerSink(Bolt):
         # Latency-decomposition stage: broker produce/confirm time.
         self._m_produce = context.metrics.histogram(
             context.component_id, "produce_ms")
+        # Egress side of distributed tracing: close sampled traces here and
+        # attach their ids as exemplars on the e2e latency histogram.
+        self._tracer = getattr(context, "tracer", None)
+        self._flight = getattr(context, "flight", None)
+        tcfg = getattr(context.config, "tracing", None)
+        self._slo_ms = float(getattr(tcfg, "slo_ms", 0.0) or 0.0)
 
     async def _timed_send(self, topic: str, value: bytes,
                           key: Optional[bytes]) -> None:
@@ -165,13 +171,14 @@ class BrokerSink(Bolt):
             task.add_done_callback(self._inflight.discard)
             self._ack_delivered(t)
         elif mode == "sync":
+            t0 = time.perf_counter()
             try:
                 await self._timed_send(topic, value, key)
             except Exception as e:
                 self.collector.report_error(e)
                 self.collector.fail(t)
                 return
-            self._ack_delivered(t)
+            self._ack_delivered(t, t0)
         else:  # async with callback
             task = asyncio.get_running_loop().create_task(
                 self._send_tracked(t, topic, value, key)
@@ -188,18 +195,40 @@ class BrokerSink(Bolt):
     async def _send_tracked(
         self, t: Tuple, topic: str, value: bytes, key: Optional[bytes]
     ) -> None:
+        t0 = time.perf_counter()
         try:
             await self._timed_send(topic, value, key)
         except Exception as e:
             self.collector.report_error(e)
             self.collector.fail(t)
             return
-        self._ack_delivered(t)
+        self._ack_delivered(t, t0)
 
-    def _ack_delivered(self, t: Tuple) -> None:
+    def _ack_delivered(self, t: Tuple, t0: Optional[float] = None) -> None:
+        """Delivery confirmed: count it, close the trace (egress span +
+        exemplar + SLO check), ack. ``t0`` is when the send started, for
+        the egress span; the exactly-once sink's commit path reuses this
+        so tracing semantics can't diverge between delivery modes."""
         self._delivered.inc()
         if t.root_ts:
-            self._latency.observe((time.perf_counter() - t.root_ts) * 1e3)
+            now = time.perf_counter()
+            ms = (now - t.root_ts) * 1e3
+            if t.trace is None:
+                self._latency.observe(ms)
+            else:
+                self._latency.observe(ms, trace_id=t.trace.trace_id)
+                if self._tracer is not None:
+                    self._tracer.record(
+                        t.trace, "egress", self.context.component_id,
+                        t0 if t0 is not None else now, now,
+                        attrs={"e2e_ms": round(ms, 3)})
+                    self._tracer.finish(t.trace, ms)
+            if self._slo_ms and ms > self._slo_ms and self._flight is not None:
+                self._flight.event(
+                    "slo_breach", throttle_s=1.0,
+                    component=self.context.component_id,
+                    e2e_ms=round(ms, 3), slo_ms=self._slo_ms,
+                    trace_id=t.trace.trace_id if t.trace is not None else None)
         self.collector.ack(t)
 
     async def flush(self) -> None:

@@ -34,6 +34,7 @@ from typing import Any, Deque, Dict, Optional, Tuple
 from storm_tpu.config import OffsetsConfig
 from storm_tpu.connectors.memory import MemoryBroker, Record
 from storm_tpu.runtime.base import Spout, TopologyContext, OutputCollector
+from storm_tpu.runtime.tracing import NOT_SAMPLED
 from storm_tpu.runtime.tuples import Values
 
 log = logging.getLogger("storm_tpu.spout")
@@ -93,6 +94,9 @@ class BrokerSpout(Spout):
     def open(self, context: TopologyContext, collector: OutputCollector) -> None:
         super().open(context, collector)
         cfg = self.offsets_cfg
+        # Cached once: _mint_trace runs per emitted record, so the tracer
+        # lookup must not be a per-record getattr chain.
+        self._tracer = getattr(context, "tracer", None)
         # Network-backed brokers (KafkaWireBroker) set blocking=True: their
         # fetches/commits run on worker threads, never on the event loop.
         self._blocking = bool(getattr(self.broker, "blocking", False))
@@ -360,27 +364,54 @@ class BrokerSpout(Spout):
             return value
         return value.decode("utf-8", "replace")
 
+    def _mint_trace(self, root_ts: float, partition: int, offset: int,
+                    records: int = 1):
+        """Sampling decision + rich ingress span for one root emit.
+
+        Returns a TraceContext, or NOT_SAMPLED so the collector knows the
+        roll already happened (and missed) — keeping the effective rate at
+        the configured value. The ingress span starts at broker-append
+        time, so it shows broker-side queueing too."""
+        tracer = self._tracer
+        if tracer is None or not tracer.active:
+            return NOT_SAMPLED
+        ctx = tracer.maybe_trace()
+        if ctx is None:
+            return NOT_SAMPLED
+        attrs = {"topic": self.topic, "partition": partition,
+                 "offset": offset}
+        if records > 1:
+            attrs["records"] = records
+        tracer.record(ctx, "ingress", self.context.component_id,
+                      root_ts, time.perf_counter(), attrs=attrs)
+        return ctx
+
     async def _emit_chunk(self, records: "list[Record]") -> None:
         first, last = records[0], records[-1]
         msg_id = ("c", first.partition, first.offset, last.offset)
         self.pending[msg_id] = records
+        root_ts = self._append_root_ts(first)
         await self.collector.emit(
             Values([[self._scheme_value(r.value) for r in records]]),
             msg_id=msg_id,
             # Oldest record in the chunk: its queueing is the one that counts.
-            root_ts=self._append_root_ts(first),
+            root_ts=root_ts,
             origins=frozenset(
                 {(self.topic, first.partition, last.offset + 1)}),
+            trace=self._mint_trace(root_ts, first.partition, first.offset,
+                                   len(records)),
         )
 
     async def _emit(self, rec: Record) -> None:
         msg_id = (rec.partition, rec.offset)
         self.pending[msg_id] = rec
+        root_ts = self._append_root_ts(rec)
         await self.collector.emit(
             Values([self._scheme_value(rec.value)]),
             msg_id=msg_id,
-            root_ts=self._append_root_ts(rec),
+            root_ts=root_ts,
             origins=frozenset({(self.topic, rec.partition, rec.offset + 1)}),
+            trace=self._mint_trace(root_ts, rec.partition, rec.offset),
         )
 
     @staticmethod

@@ -338,6 +338,51 @@ class DistCluster:
         return {i: c.control("health")["health"]
                 for i, c in enumerate(self.clients)}
 
+    def traces(self, n: int = 20) -> Dict[str, Any]:
+        """Merged distributed-trace picture: every worker holds only the
+        spans its own executors recorded, so records are merged by trace id
+        (spans deduped by span id and tagged with the recording worker).
+        Span ``offset_ms`` values are relative to each worker's own
+        perf_counter domain — comparable within a worker, not across.
+        Flight-recorder events carry wall timestamps and merge cleanly."""
+        merged: Dict[str, dict] = {}
+        flight: List[dict] = []
+        stats: Dict[str, Any] = {}
+        for i, c in enumerate(self.clients):
+            sl = c.control("traces", n=n)
+            if "stats" in sl:
+                stats[str(i)] = sl["stats"]
+            for ev in sl.get("flight") or []:
+                flight.append({**ev, "worker": i})
+            for rec in ((sl.get("recent") or []) + (sl.get("slowest") or [])
+                        + (sl.get("open") or [])):
+                cur = merged.get(rec["trace_id"])
+                if cur is None:
+                    cur = {"trace_id": rec["trace_id"],
+                           "opened_at": rec["opened_at"],
+                           "duration_ms": rec.get("duration_ms"),
+                           "spans": []}
+                    merged[rec["trace_id"]] = cur
+                else:
+                    cur["opened_at"] = min(cur["opened_at"], rec["opened_at"])
+                    if cur.get("duration_ms") is None:
+                        cur["duration_ms"] = rec.get("duration_ms")
+                seen = {s["span_id"] for s in cur["spans"]}
+                for s in rec["spans"]:
+                    if s["span_id"] not in seen:
+                        cur["spans"].append({**s, "worker": i})
+                        seen.add(s["span_id"])
+        recs = list(merged.values())
+        flight.sort(key=lambda e: e.get("ts", 0.0))
+        return {
+            "slowest": sorted(recs, key=lambda r: r.get("duration_ms") or 0.0,
+                              reverse=True)[:n],
+            "recent": sorted(recs, key=lambda r: r["opened_at"],
+                             reverse=True)[:n],
+            "stats": stats,
+            "flight": flight[-n:],
+        }
+
     def worker_logs(self, index: int, tail_bytes: int = 16384) -> str:
         """Tail of a spawned worker's stderr (the Storm logviewer
         equivalent). pread leaves the fd offset alone — the file

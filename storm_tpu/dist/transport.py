@@ -23,10 +23,11 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Any, Dict, Iterable, List, Tuple as Tup
+from typing import Any, Dict, Iterable, List, Optional, Tuple as Tup
 
 import grpc
 
+from storm_tpu.runtime.tracing import TraceContext
 from storm_tpu.runtime.tuples import Tuple
 
 SERVICE = "storm_tpu.Dist"
@@ -64,6 +65,10 @@ def encode_tuple(t: Tuple, now: float) -> list:
         # sequential positions (nowhere near 2^53), so plain JSON ints
         # are lossless — unlike the random 64-bit ids above.
         [[tp, p, off] for tp, p, off in t.origins],
+        # Distributed-trace context as a W3C traceparent string (None for
+        # the unsampled common case) — trailing element per the versioning
+        # contract in decode_tuple, so pre-tracing receivers ignore it.
+        t.trace.traceparent() if t.trace is not None else None,
     ]
 
 
@@ -82,6 +87,7 @@ def decode_tuple(enc: list, now: float) -> Tuple:
     # that boundary must be all-at-once (stop every worker, then restart).
     values, fields, stream, src, src_task, edge, anchors, age = enc[:8]
     origins = enc[8] if len(enc) > 8 else []
+    tp_hdr = enc[9] if len(enc) > 9 else None
     return Tuple(
         values=values,
         fields=tuple(fields),
@@ -92,6 +98,9 @@ def decode_tuple(enc: list, now: float) -> Tuple:
         anchors=frozenset(int(a) for a in anchors),
         root_ts=now - age,
         origins=frozenset((tp, p, off) for tp, p, off in origins),
+        # from_traceparent returns None on malformed/absent input, so a
+        # garbled header degrades to "unsampled" rather than failing the RPC.
+        trace=TraceContext.from_traceparent(tp_hdr) if tp_hdr else None,
     )
 
 
@@ -135,7 +144,7 @@ class WorkerClient:
     STORM_TPU_CONTROL_TOKEN (the controller's export); a non-empty token
     rides every RPC as metadata."""
 
-    def __init__(self, target: str, token: str = None) -> None:
+    def __init__(self, target: str, token: Optional[str] = None) -> None:
         self.target = target
         if token is None:
             token = _env_token()
@@ -145,8 +154,15 @@ class WorkerClient:
         self._ack = self._channel.unary_unary(f"/{SERVICE}/Ack")
         self._control = self._channel.unary_unary(f"/{SERVICE}/Control")
 
-    def deliver(self, payload: bytes, timeout: float = 60.0) -> None:
-        self._deliver(payload, timeout=timeout, metadata=self._md)
+    def deliver(self, payload: bytes, timeout: float = 60.0,
+                traceparent: Optional[str] = None) -> None:
+        """``traceparent`` (first sampled tuple of the batch) rides as W3C
+        gRPC metadata so proxies/interceptors that only see headers — not
+        the opaque envelope — can still correlate the RPC to a trace."""
+        md = self._md or ()
+        if traceparent:
+            md = md + (("traceparent", traceparent),)
+        self._deliver(payload, timeout=timeout, metadata=md or None)
 
     def ack(self, payload: bytes, timeout: float = 60.0) -> None:
         self._ack(payload, timeout=timeout, metadata=self._md)
@@ -183,7 +199,7 @@ class DistHandler(grpc.GenericRpcHandler):
     rejected UNAUTHENTICATED with a log line."""
 
     def __init__(self, deliver_fn, ack_fn, control_fn,
-                 token: str = None) -> None:
+                 token: Optional[str] = None) -> None:
         if token is None:
             token = _env_token()
         if token:

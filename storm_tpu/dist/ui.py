@@ -168,6 +168,9 @@ class DistRuntimeView:
         return await asyncio.to_thread(
             self._dist.profile, worker, log_dir, seconds)
 
+    async def traces(self, n: int = 20) -> Dict[str, Any]:
+        return await asyncio.to_thread(self._dist.traces, n)
+
     async def worker_logs(self, index: int, tail_bytes: int = 16384) -> str:
         return await asyncio.to_thread(self._dist.worker_logs, index, tail_bytes)
 

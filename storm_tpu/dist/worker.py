@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import functools
 import json
 import logging
 import sys
@@ -104,8 +105,14 @@ class PeerSender:
                 if acks:
                     await self._send(self.client.ack, transport.encode_acks(acks))
                 if tuples:
+                    # First sampled tuple's context doubles as the RPC-level
+                    # traceparent header (per-tuple contexts travel in the
+                    # envelope itself; the header is for gRPC-aware proxies).
+                    tp = next((t.trace.traceparent() for _c, _i, t in tuples
+                               if t.trace is not None), None)
                     await self._send(
-                        self.client.deliver, transport.encode_deliveries(tuples)
+                        functools.partial(self.client.deliver, traceparent=tp),
+                        transport.encode_deliveries(tuples),
                     )
             except Exception as e:
                 # Exhausted retries: the affected trees hit the ledger
@@ -456,6 +463,17 @@ class WorkerServer:
         rt = self.rt  # snapshot: a concurrent 'kill' may null the attribute
         if rt is None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no topology")
+        # W3C traceparent metadata (PeerSender attaches the batch's first
+        # sampled context): adopting it stamps the trace's arrival on this
+        # worker before any executor span, so cross-host transit shows up
+        # as the gap between the sender's last span and ours.
+        tracer = getattr(rt, "tracer", None)
+        if tracer is not None and tracer.active:
+            md = dict(context.invocation_metadata() or ())
+            tctx = transport.TraceContext.from_traceparent(
+                md.get("traceparent"))
+            if tctx is not None:
+                tracer.adopt(tctx)
         rt.deliver_threadsafe(request, self.loop)
         return b"{}"
 
@@ -561,6 +579,24 @@ class WorkerServer:
             return {"ok": True}
         if cmd == "metrics":
             return {"metrics": self.rt.metrics.snapshot()}
+        if cmd == "traces":
+            # This worker's slice of the distributed trace picture: the
+            # controller (UI /traces action) merges slices from every
+            # worker — each holds only the spans its executors recorded.
+            n = int(req.get("n", 20))
+            tracer = getattr(self.rt, "tracer", None)
+            flight = getattr(self.rt, "flight", None)
+            out: Dict[str, Any] = {"index": self.index}
+            if tracer is not None:
+                out["slowest"] = tracer.store.slowest(n)
+                out["recent"] = tracer.store.recent(n)
+                # A worker that doesn't host the sink never finishes a
+                # record; its whole slice lives in the open map.
+                out["open"] = tracer.store.open_records(n)
+                out["stats"] = tracer.store.stats()
+            if flight is not None:
+                out["flight"] = flight.tail(n)
+            return out
         if cmd == "health":
             return {"health": self.rt.health()}
         if cmd == "deactivate":

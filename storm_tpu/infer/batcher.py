@@ -29,7 +29,9 @@ class BatchItem:
     data: np.ndarray  # (n_i, *instance_shape)
     ts: float  # deadline clock: root (append) time when known
     # batcher-entry time (always perf_counter-now at add): what the
-    # batch-wait stage of the latency decomposition is measured from
+    # batch-wait stage of the latency decomposition is measured from, and
+    # the start of a sampled record's queue_wait trace span (the operator's
+    # _trace_batch — batcher entry to device dispatch).
     enq: float = 0.0
 
 

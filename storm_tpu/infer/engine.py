@@ -18,6 +18,7 @@ import gc
 import logging
 import sys
 import threading
+import time
 from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Dict, Optional, Tuple
@@ -326,6 +327,10 @@ class InferenceEngine:
         self._x_sharding = x_shard
         self._scalar_sharding = replicated(self.mesh)
         self.compiled_batches: set = set()
+        # Observability hook: called as ``on_compile(padded_batch, ms)``
+        # the first time a bucket shape executes (= XLA compile on the hot
+        # path). The inference operator wires it to the flight recorder.
+        self.on_compile = None
 
     # ---- memory accounting ---------------------------------------------------
 
@@ -391,6 +396,8 @@ class InferenceEngine:
         """
         n = x.shape[0]
         padded = self.pad_batch(n)
+        cold = padded not in self.compiled_batches
+        t_compile = time.perf_counter() if cold else 0.0
         if self._quantize:
             # Range from the real rows only (padding would drag lo to 0).
             lo = float(x.min())
@@ -416,6 +423,12 @@ class InferenceEngine:
                 out = self._fwd(self.params, self.state, xd)
                 gathered = self._gather_locked(out)
         self.compiled_batches.add(padded)
+        if cold and self.on_compile is not None:
+            try:
+                self.on_compile(padded,
+                                (time.perf_counter() - t_compile) * 1e3)
+            except Exception:
+                pass  # an observability hook must never fail a batch
         if gathered is None:
             # single-process: the host fetch happens OUTSIDE the lock so
             # one batch's device->host RTT doesn't serialize the next

@@ -139,6 +139,16 @@ class InferenceBolt(Bolt):
         self._m_ingest = m.histogram(cid, "ingest_lag_ms")  # append -> bolt
         self._m_batch_wait = m.histogram(cid, "batch_wait_ms")  # in batcher
         self._m_disp_wait = m.histogram(cid, "dispatch_wait_ms")  # sem queue
+        # Distributed tracing + flight recorder (runtime/tracing.py).
+        self._tracer = getattr(context, "tracer", None)
+        self._flight = getattr(context, "flight", None)
+        if self._flight is not None:
+            # Cold XLA compiles ride the hot path (a new bucket shape) —
+            # exactly the latency cliff a post-mortem needs to see.
+            self.engine.on_compile = (
+                lambda shape, ms, cid=cid, fl=self._flight: fl.event(
+                    "xla_compile", component=cid, batch_shape=shape,
+                    compile_ms=round(ms, 1)))
 
     # ---- ingest --------------------------------------------------------------
 
@@ -290,15 +300,54 @@ class InferenceBolt(Bolt):
         self._inflight.add(task)
         task.add_done_callback(self._inflight.discard)
 
+    def _trace_batch(self, batch: Batch, t0: float, t1: float) -> None:
+        """Span bookkeeping for one device round trip: a ``queue_wait``
+        span per SAMPLED record (batcher entry -> device start) and ONE
+        shared ``device_execute`` span — same span id in every
+        participating trace, linked to all member record spans — so the
+        fan-in of N records into one batch is first-class in the trace
+        (and queue-wait vs. device time separable per record). Only
+        called when the tracer is active; per-record work only for
+        sampled records."""
+        tracer = self._tracer
+        cid = self.context.component_id
+        traced = []
+        for it in batch.items:
+            ctx = self._anchor_of(it.payload).trace
+            if ctx is not None:
+                traced.append((ctx, tracer.record(
+                    ctx, "queue_wait", cid, it.enq or t0, t0)))
+        if not traced:
+            return
+        batch_span = tracer.new_span_id()
+        links = tuple(qid for _, qid in traced)
+        attrs = {"batch_size": batch.size, "records": len(batch.items)}
+        for ctx, qid in traced:
+            tracer.record(ctx, "device_execute", cid, t0, t1,
+                          span_id=batch_span, parent_id=qid,
+                          links=links, attrs=attrs)
+
     async def _run_batch(self, batch: Batch) -> None:
         try:
             x = batch.stack()
             t0 = time.perf_counter()
             # Worker thread: the loop keeps batching while the TPU computes.
             out = await asyncio.to_thread(self.engine.predict, x)
-            self._m_device_ms.observe((time.perf_counter() - t0) * 1e3)
+            t1 = time.perf_counter()
+            self._m_device_ms.observe((t1 - t0) * 1e3)
             self._m_batch.observe(batch.size)
             self._m_infer.inc(batch.size)
+            if self._tracer is not None and self._tracer.active:
+                self._trace_batch(batch, t0, t1)
+            if self._flight is not None:
+                # Sampled (throttled) batch-formed events: enough to see
+                # batch-size/device-time behavior in a post-mortem without
+                # a per-batch firehose at production rates.
+                self._flight.event(
+                    "batch_formed", throttle_s=1.0,
+                    component=self.context.component_id,
+                    size=batch.size, records=len(batch.items),
+                    device_ms=round((t1 - t0) * 1e3, 3))
             for item, preds in batch.split(out):
                 anchor = self._anchor_of(item)
                 with span(self.context.metrics, self.context.component_id,

@@ -343,6 +343,63 @@ def _ctl(args) -> int:
     return rc
 
 
+def _traces(args) -> int:
+    """Dump slowest-N traces / flight-recorder tail from a running
+    topology's UI endpoint (storm_tpu traces <topology>)."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from storm_tpu.config import env_control_token
+
+    base = args.url.rstrip("/")
+    topo = urllib.parse.quote(args.topology, safe="")
+    action = "flight" if args.flight else "traces"
+    req = urllib.request.Request(
+        f"{base}/api/v1/topology/{topo}/{action}?n={args.n}")
+    token = args.token or env_control_token()
+    if token:  # read route is open; header is harmless if unneeded
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        print(e.read().decode("utf-8", "replace"), file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    if args.flight:
+        for ev in out.get("flight", []):
+            extra = {k: v for k, v in ev.items() if k not in ("ts", "kind")}
+            print(f"{ev.get('ts')} {ev.get('kind'):<18} "
+                  + " ".join(f"{k}={v}" for k, v in extra.items()))
+        return 0
+    order = "recent" if args.recent else "slowest"
+    for rec in out.get(order, []):
+        print(f"trace {rec['trace_id']}  "
+              f"duration={rec.get('duration_ms')}ms  "
+              f"opened_at={rec.get('opened_at')}")
+        for s in rec.get("spans", []):
+            attrs = s.get("attrs") or {}
+            links = s.get("links") or []
+            parts = [f"  +{s.get('offset_ms'):>9}ms {s['name']:<15} "
+                     f"{s.get('duration_ms'):>9}ms  {s.get('component', '')}"]
+            if attrs:
+                parts.append(" " + " ".join(f"{k}={v}"
+                                            for k, v in attrs.items()))
+            if links:
+                parts.append(f" links={len(links)}")
+            print("".join(parts))
+    stats = out.get("stats")
+    if stats:
+        print(f"store: {json.dumps(stats, default=str)}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     setup_logging()
     ap = argparse.ArgumentParser(prog="storm_tpu")
@@ -476,6 +533,26 @@ def main(argv=None) -> int:
     c.add_argument("topology")
     c.add_argument("definition", help="TOML/JSON topology file")
 
+    tracesp = sub.add_parser(
+        "traces",
+        help="dump the slowest traces (or the flight-recorder tail) from a "
+             "running topology's UI endpoint; needs tracing.sample_rate > 0 "
+             "on the daemon for span data")
+    tracesp.add_argument("topology")
+    tracesp.add_argument("--url", default="http://127.0.0.1:8080",
+                         help="base URL of the daemon's --ui-port server")
+    tracesp.add_argument("--token", default=None,
+                         help="bearer token (default: "
+                              "$STORM_TPU_CONTROL_TOKEN)")
+    tracesp.add_argument("-n", type=int, default=10,
+                         help="how many traces/events to show")
+    tracesp.add_argument("--recent", action="store_true",
+                         help="most recent traces instead of slowest")
+    tracesp.add_argument("--flight", action="store_true",
+                         help="flight-recorder events only")
+    tracesp.add_argument("--json", action="store_true",
+                         help="raw JSON instead of the rendered view")
+
     args = ap.parse_args(argv)
 
     if args.cmd == "run":
@@ -497,6 +574,9 @@ def main(argv=None) -> int:
 
     if args.cmd == "ctl":
         return _ctl(args)
+
+    if args.cmd == "traces":
+        return _traces(args)
 
     if args.cmd == "dist-run":
         cfg = _load_config(args)

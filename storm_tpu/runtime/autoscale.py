@@ -130,6 +130,7 @@ class Autoscaler:
             )
             await self.rt.rebalance(p.component, new)
             self.decisions.append(("up", current, new))
+            self._flight("up", current, new, p50, inbox_frac)
             self._hot = 0
             return new
         if self._calm >= p.cooldown and current > p.min_parallelism:
@@ -137,6 +138,20 @@ class Autoscaler:
             log.info("scaling %s DOWN %d->%d (p50=%s ms)", p.component, current, new, p50)
             await self.rt.rebalance(p.component, new)
             self.decisions.append(("down", current, new))
+            self._flight("down", current, new, p50, inbox_frac)
             self._calm = 0
             return new
         return None
+
+    def _flight(self, direction: str, current: int, new: int,
+                p50, inbox_frac: float) -> None:
+        """Flight-recorder breadcrumb: every scaling decision plus the
+        signals that drove it, for post-mortems of soak/chaos runs."""
+        flight = getattr(self.rt, "flight", None)
+        if flight is not None:
+            flight.event(
+                "autoscale_decision", component=self.policy.component,
+                direction=direction, parallelism=(current, new),
+                p50_ms=round(p50, 3) if p50 is not None else None,
+                inbox_frac=round(inbox_frac, 3),
+            )

@@ -17,6 +17,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from storm_tpu.runtime.groupings import DirectGrouping
+from storm_tpu.runtime.tracing import NOT_SAMPLED
 from storm_tpu.runtime.tuples import Tuple, Values, merge_offsets, new_id
 
 
@@ -30,12 +31,19 @@ class TopologyContext:
         parallelism: int,
         config: Any,
         metrics: "Any" = None,
+        *,
+        tracer: "Any" = None,
+        flight: "Any" = None,
     ) -> None:
         self.component_id = component_id
         self.task_index = task_index
         self.parallelism = parallelism
         self.config = config
         self.metrics = metrics
+        # Distributed tracing + flight recorder (runtime/tracing.py); None
+        # outside a full runtime (unit-constructed contexts).
+        self.tracer = tracer
+        self.flight = flight
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"<TopologyContext {self.component_id}[{self.task_index}/{self.parallelism}]>"
@@ -57,6 +65,7 @@ class OutputCollector:
         self._m_emitted = runtime.metrics.counter(component_id, "emitted")
         self._m_acked = runtime.metrics.counter(component_id, "acked")
         self._m_failed = runtime.metrics.counter(component_id, "failed")
+        self._tracer = getattr(runtime, "tracer", None)
 
     def set_output_fields(self, fields: Dict[str, Sequence[str]]) -> None:
         self._out_fields = fields
@@ -73,6 +82,7 @@ class OutputCollector:
         root_ts: Optional[float] = None,
         origins: Optional[frozenset] = None,
         direct_task: Optional[int] = None,
+        trace: Any = None,
     ) -> int:
         """Emit a tuple downstream. Returns the number of deliveries.
 
@@ -94,6 +104,14 @@ class OutputCollector:
             roots = frozenset().union(*(a.anchors for a in anchor_list))
             if anchor_list and root_ts is None:
                 ts = min(a.root_ts for a in anchor_list)
+            if trace is None:
+                # Trace context follows anchoring, like root_ts/origins.
+                # Attribute reads only — no allocation when nothing is
+                # sampled (the overwhelmingly common case).
+                for a in anchor_list:
+                    if a.trace is not None:
+                        trace = a.trace
+                        break
             if origins is None and any(a.origins for a in anchor_list):
                 # Provenance follows anchoring: a derived tuple carries the
                 # source-log positions of everything it was computed from.
@@ -151,6 +169,18 @@ class OutputCollector:
                 ts,
             )
             roots = frozenset((root_id,))
+            if trace is None and self._tracer is not None and self._tracer.active:
+                # Sampling fallback for spouts that don't mint their own
+                # context (BrokerSpout does, and passes ``trace=``; a miss
+                # there arrives as NOT_SAMPLED so the rate isn't doubled):
+                # give every sampled root at least a generic ingress span.
+                trace = self._tracer.maybe_trace()
+                if trace is not None:
+                    self._tracer.record(
+                        trace, "ingress", self.component_id,
+                        ts, time.perf_counter())
+        if trace is NOT_SAMPLED:
+            trace = None
 
         # XOR every new edge into the ledger BEFORE the first (possibly
         # yielding) queue put — otherwise a fast consumer could zero the
@@ -173,6 +203,7 @@ class OutputCollector:
                 anchors=roots,
                 root_ts=ts,
                 origins=origin_set,
+                trace=trace,
             )
             await inbox.put(t)
             n += 1

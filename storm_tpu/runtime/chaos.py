@@ -48,6 +48,7 @@ class ChaosMonkey:
 
         e.bolt.execute = boom
         self.kills += 1
+        self._flight("bolt", component_id, index)
 
     def crash_spout(self, component_id: str, index: int = 0) -> None:
         """Kill spout executor ``component_id[index]`` on its next pull."""
@@ -58,6 +59,16 @@ class ChaosMonkey:
 
         e.spout.next_tuple = boom
         self.kills += 1
+        self._flight("spout", component_id, index)
+
+    def _flight(self, kind: str, component_id: str, index: int) -> None:
+        """Injections land in the flight recorder so a post-mortem can line
+        executor restarts / replays up against what chaos actually did."""
+        flight = getattr(self.rt, "flight", None)
+        if flight is not None:
+            flight.event("chaos_injection", target=kind,
+                         component=component_id, task=index,
+                         kills=self.kills)
 
     def crash_random(self) -> str:
         """Kill one uniformly-random executor; returns its id."""

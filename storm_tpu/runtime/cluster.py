@@ -69,6 +69,19 @@ class TopologyRuntime:
         from storm_tpu.runtime.state import make_backend
 
         self.state_backend = make_backend(config.topology.state_dir)
+        from storm_tpu.runtime.tracing import FlightRecorder, Tracer
+
+        tr = getattr(config, "tracing", None)
+        self.tracer = Tracer(
+            sample_rate=getattr(tr, "sample_rate", 0.0),
+            store_capacity=getattr(tr, "store_capacity", 256),
+        )
+        self.flight = FlightRecorder(
+            path=getattr(tr, "flight_path", ""),
+            capacity=getattr(tr, "flight_capacity", 512),
+            max_bytes=getattr(tr, "flight_max_bytes", 4 * 1024 * 1024),
+            max_files=getattr(tr, "flight_max_files", 3),
+        )
         self.ledger = AckLedger(timeout_s=config.topology.message_timeout_s)
         self.router = Router()
         self.groups: Dict[str, TargetGroup] = {}
@@ -143,6 +156,7 @@ class TopologyRuntime:
             n = self.ledger.sweep()
             if n:
                 log.warning("%s: %d tuple trees timed out", self.name, n)
+                self.flight.event("tree_timeout", topology=self.name, trees=n)
             self._supervise()
             # Backpressure visibility: queued tuples per bolt component
             # (Storm UI's capacity/queue columns; the autoscaler's other
@@ -177,6 +191,8 @@ class TopologyRuntime:
             exc = old._task.exception()
             log.error("executor %s[%d] died (%r); restarting", cid, i, exc)
             self.metrics.counter(cid, "executor_restarts").inc()
+            self.flight.event("executor_restart", topology=self.name,
+                              component=cid, task=i, error=repr(exc))
             try:
                 dispose()  # release the crashed component's resources
             except Exception as ce:
@@ -348,6 +364,7 @@ class TopologyRuntime:
         for execs in self.bolt_execs.values():
             for e in execs:
                 await e.stop(drain=wait_secs > 0)
+        self.flight.close()
 
     # ---- elasticity ----------------------------------------------------------
 

@@ -66,6 +66,8 @@ class BoltExecutor:
             self.rt.parallelism_of(self.component_id),
             self.rt.config,
             self.rt.metrics,
+            tracer=getattr(self.rt, "tracer", None),
+            flight=getattr(self.rt, "flight", None),
         )
         self.bolt.prepare(ctx, self.collector)
         self._init_state()
@@ -131,6 +133,7 @@ class BoltExecutor:
         m = self.rt.metrics
         executed = m.counter(self.component_id, "executed")
         exec_ms = m.histogram(self.component_id, "execute_ms")
+        tracer = getattr(self.rt, "tracer", None)
         while True:
             item = await self.inbox.get()
             if item is _STOP:
@@ -155,9 +158,13 @@ class BoltExecutor:
                     finally:
                         # Count time for failed executes too, or a failing
                         # bolt reports a misleadingly low average.
-                        dt_ms = (_time.perf_counter() - t0) * 1e3
+                        t1 = _time.perf_counter()
+                        dt_ms = (t1 - t0) * 1e3
                         exec_ms.observe(dt_ms)
                         self.exec_ms_total += dt_ms
+                        if t.trace is not None and tracer is not None:
+                            tracer.record(t.trace, "execute",
+                                          self.component_id, t0, t1)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # fail the tuple, keep the executor alive
@@ -259,6 +266,8 @@ class SpoutExecutor:
             self.rt.parallelism_of(self.component_id),
             self.rt.config,
             self.rt.metrics,
+            tracer=getattr(self.rt, "tracer", None),
+            flight=getattr(self.rt, "flight", None),
         )
         self.spout.open(ctx, self.collector)
         self._task = asyncio.create_task(

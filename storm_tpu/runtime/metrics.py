@@ -47,13 +47,19 @@ class Histogram:
         self._i = 0
         self.count = 0
         self.sum = 0.0
+        # Latest sampled (trace_id, value, wall_ts): rendered as an
+        # OpenMetrics exemplar so a dashboard histogram links to the trace
+        # that produced the point. None until a sampled record observes.
+        self.exemplar = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
         self._buf[self._i] = v
         self._i = (self._i + 1) % len(self._buf)
         self._n = min(self._n + 1, len(self._buf))
         self.count += 1
         self.sum += v
+        if trace_id is not None:
+            self.exemplar = (trace_id, v, time.time())
 
     def percentile(self, q: float) -> float:
         if self._n == 0:
@@ -67,6 +73,7 @@ class Histogram:
         self._i = 0
         self.count = 0
         self.sum = 0.0
+        self.exemplar = None
 
     @property
     def mean(self) -> float:
@@ -218,7 +225,15 @@ def prometheus_text(registries: Dict[str, "MetricsRegistry"]) -> str:
             lines.append(f"{name_of(mname)}{labels} {sane(g.value)}")
         for (comp, mname), h in sorted(reg._histograms.items()):
             labels = f'{{topology="{_prom_escape(topo)}",component="{_prom_escape(comp)}"}}'
-            lines.append(f"{name_of(mname, '_count')}{labels} {h.count}")
+            # OpenMetrics exemplar on the _count series: the latest sampled
+            # observation's trace id, so a dashboard can jump from a
+            # latency panel straight to the trace behind the point.
+            ex = ""
+            if h.exemplar is not None:
+                tid, ev, ets = h.exemplar
+                ex = (f' # {{trace_id="{_prom_escape(str(tid))}"}}'
+                      f" {sane(ev)} {round(ets, 3)}")
+            lines.append(f"{name_of(mname, '_count')}{labels} {h.count}{ex}")
             lines.append(f"{name_of(mname, '_sum')}{labels} {sane(h.sum)}")
             snap = h.snapshot()
             for q in ("mean", "p50", "p95", "p99"):

@@ -93,6 +93,9 @@ class Tuple:
     # commit the consumed offsets inside its producer transaction (KIP-98
     # consume-transform-produce exactly-once).
     origins: FrozenSet[tuple] = frozenset()
+    # Distributed-trace context (tracing.TraceContext) — None unless this
+    # record was sampled, so the tracing-off hot path pays only the field.
+    trace: Optional[Any] = None
 
     def __getitem__(self, i: int) -> Any:
         return self.values[i]

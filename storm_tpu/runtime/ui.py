@@ -18,6 +18,9 @@ Read routes
     GET /api/v1/topology/{name}/component/{id}  per-executor stats table
     GET /api/v1/topology/{name}/logs          dist worker stderr tail
                                               (?worker=N&bytes=M)
+    GET /api/v1/topology/{name}/traces        slowest/recent trace trees +
+                                              flight tail (?n=20)
+    GET /api/v1/topology/{name}/flight        flight-recorder events only
     GET /metrics                              Prometheus text exposition
 
 Admin routes (POST, like Storm UI's topology actions)
@@ -350,6 +353,36 @@ class UIServer:
                 if graph is None:
                     return 404, {"error": "graph unavailable for this runtime"}
                 return 200, graph
+            if action in ("traces", "flight"):
+                # Slowest/recent trace trees + flight-recorder tail
+                # (?n= caps list sizes). /flight is the events-only view.
+                if method != "GET":
+                    return 405, {"error": "use GET"}
+                try:
+                    n = int(query.get("n", 20))
+                except ValueError:
+                    return 400, {"error": "n must be an int"}
+                if not 1 <= n <= 500:
+                    return 400, {"error": "n must be in [1, 500]"}
+                if hasattr(rt, "traces"):
+                    # dist view: per-worker RPC fan-out, already off-loop
+                    data = await rt.traces(n)
+                else:
+                    tracer = getattr(rt, "tracer", None)
+                    flight = getattr(rt, "flight", None)
+                    if tracer is None and flight is None:
+                        return 404, {"error": "tracing unavailable for "
+                                              "this runtime"}
+                    data = {
+                        "slowest": tracer.store.slowest(n) if tracer else [],
+                        "recent": tracer.store.recent(n) if tracer else [],
+                        "stats": tracer.store.stats() if tracer else {},
+                        "flight": flight.tail(n) if flight else [],
+                    }
+                if action == "flight":
+                    return 200, {"topology": rt.name,
+                                 "flight": data.get("flight", [])}
+                return 200, {"topology": rt.name, **data}
             if action in ("metrics", "errors"):
                 if method != "GET":
                     return 405, {"error": "use GET"}

@@ -126,6 +126,7 @@ def test_dist_three_workers_end_to_end():
         cfg.topology.inference_parallelism = 2
         cfg.topology.sink_parallelism = 1
         cfg.topology.message_timeout_s = 60.0
+        cfg.tracing.sample_rate = 1.0  # every record traced across workers
 
         placement = {
             "kafka-spout": 0,
@@ -176,6 +177,21 @@ def test_dist_three_workers_end_to_end():
             assert snap["inference-bolt"]["dead_lettered"] >= 1
             health = cluster.health()
             assert len(health) == 3
+
+            # Cross-worker tracing: the controller merge stitches each
+            # worker's slice (ingress on w0, queue/device on w1, egress on
+            # w2) into one record per trace id.
+            tr = cluster.traces(50)
+            recs = tr["slowest"] + tr["recent"]
+            assert recs, "no traces captured at sample_rate=1.0"
+            names = {s["name"] for r in recs for s in r["spans"]}
+            workers = {s["worker"] for r in recs for s in r["spans"]}
+            assert "egress" in names  # sink worker finished the records
+            assert {"ingress", "queue_wait", "device_execute"} & names
+            assert len(workers) >= 2, f"spans from one worker only: {workers}"
+            # at least one merged record spans processes
+            assert any(len({s["worker"] for s in r["spans"]}) >= 2
+                       for r in recs)
             # drain() deactivated the spouts; resume them before the next phase
             cluster.activate()
 
@@ -697,3 +713,84 @@ def test_dist_control_plane_auth():
             del os.environ[transport.TOKEN_ENV]
         else:  # pragma: no cover - only when the dev shell exports it
             os.environ[transport.TOKEN_ENV] = prev
+
+
+def test_tuple_envelope_trace_roundtrip():
+    """Sampled trace context crosses the wire inside the envelope; legacy
+    9-element envelopes and malformed headers degrade to trace=None."""
+    from storm_tpu.runtime.tracing import TraceContext
+
+    t = Tuple(values=["x"], fields=("message",), source_component="s",
+              source_task=0, stream="default", edge_id=1,
+              anchors=frozenset(), root_ts=time.perf_counter(),
+              trace=TraceContext("ab" * 16, "cd" * 8))
+    enc = transport.encode_tuple(t, time.perf_counter())
+    assert enc[9] == t.trace.traceparent()
+    back = transport.decode_tuple(enc, time.perf_counter())
+    assert back.trace.trace_id == t.trace.trace_id
+    assert back.trace.span_id == t.trace.span_id
+    # unsampled: explicit None element, decoded back to None
+    t2 = Tuple(values=["x"], fields=("message",), source_component="s",
+               source_task=0, stream="default", edge_id=1,
+               anchors=frozenset(), root_ts=0.0)
+    enc2 = transport.encode_tuple(t2, 0.0)
+    assert enc2[9] is None
+    assert transport.decode_tuple(enc2, 0.0).trace is None
+    # pre-tracing sender (9 elements) and a garbled header
+    assert transport.decode_tuple(enc[:9], 0.0).trace is None
+    enc[9] = "00-garbage-01"
+    assert transport.decode_tuple(enc, 0.0).trace is None
+
+
+def test_deliver_carries_traceparent_grpc_metadata():
+    """WorkerClient.deliver attaches the batch's traceparent as W3C gRPC
+    metadata alongside the auth token; the receiving DistHandler sees both
+    and the envelope still decodes the per-tuple context."""
+    import grpc
+    from concurrent import futures
+
+    from storm_tpu.dist.transport import DistHandler, WorkerClient
+    from storm_tpu.runtime.tracing import TraceContext
+
+    seen = {}
+
+    def deliver_fn(request, context):
+        seen["md"] = dict(context.invocation_metadata() or ())
+        seen["tuples"] = transport.decode_deliveries(request)
+        return b"{}"
+
+    def other(request, context):
+        return b"{}"
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers(
+        (DistHandler(deliver_fn, other, other, token="tok"),))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        ctx = TraceContext("ab" * 16, "cd" * 8)
+        t = Tuple(values=["x"], fields=("message",), source_component="s",
+                  source_task=0, stream="default", edge_id=1,
+                  anchors=frozenset(), root_ts=time.perf_counter(),
+                  trace=ctx)
+        client = WorkerClient(f"127.0.0.1:{port}", token="tok")
+        try:
+            client.deliver(transport.encode_deliveries([("bolt", 0, t)]),
+                           traceparent=ctx.traceparent())
+        finally:
+            client.close()
+        assert seen["md"]["traceparent"] == ctx.traceparent()
+        assert seen["md"]["x-storm-tpu-token"] == "tok"
+        [(comp, task, back)] = seen["tuples"]
+        assert back.trace.trace_id == ctx.trace_id
+
+        # wrong token still rejected even with a traceparent attached
+        bad = WorkerClient(f"127.0.0.1:{port}", token="wrong")
+        try:
+            with pytest.raises(grpc.RpcError):
+                bad.deliver(transport.encode_deliveries([("bolt", 0, t)]),
+                            traceparent=ctx.traceparent())
+        finally:
+            bad.close()
+    finally:
+        server.stop(None)

@@ -150,3 +150,32 @@ def test_rate_gauges_published(run):
         await cluster.shutdown()
 
     run(go(), timeout=60)
+
+
+def test_prometheus_exemplar_rendering():
+    """A trace-id-tagged observation renders as an OpenMetrics exemplar on
+    the histogram's _count line; untagged histograms stay exemplar-free."""
+    reg = MetricsRegistry()
+    tid = "ab" * 16
+    reg.histogram("sink", "e2e_latency_ms").observe(12.0, trace_id=tid)
+    reg.histogram("bolt", "execute_ms").observe(3.0)
+    text = prometheus_text({"demo": reg})
+    count_line = next(l for l in text.splitlines()
+                      if l.startswith("storm_tpu_e2e_latency_ms_count"))
+    assert f'# {{trace_id="{tid}"}} 12.0' in count_line
+    exec_lines = [l for l in text.splitlines() if "execute_ms" in l]
+    assert exec_lines and all("# {" not in l for l in exec_lines)
+
+
+def test_prometheus_exemplar_tracks_latest_and_reset():
+    reg = MetricsRegistry()
+    h = reg.histogram("sink", "e2e_latency_ms")
+    h.observe(5.0, trace_id="aa" * 16)
+    h.observe(7.0, trace_id="bb" * 16)
+    h.observe(9.0)  # unsampled record must not clear the exemplar
+    text = prometheus_text({"demo": reg})
+    assert 'trace_id="' + "bb" * 16 + '"' in text
+    assert "aa" * 16 not in text
+    h.reset()
+    text = prometheus_text({"demo": reg})
+    assert "# {" not in text
