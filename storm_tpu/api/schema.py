@@ -76,6 +76,27 @@ class DeadLetter:
         )
 
 
+@dataclass(frozen=True)
+class Overloaded:
+    """A typed rejection emitted when load shedding drops an admitted
+    record at the inference operator (QosConfig, storm_tpu.qos): the
+    client gets an immediate, parseable answer instead of a timeout.
+    Distinguishable from :class:`DeadLetter` (malformed input) and from
+    predictions (``"overloaded"`` key instead of ``"predictions"``)."""
+
+    lane: str = ""
+    tenant: str = ""
+    shed_level: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "overloaded": True,
+            "lane": self.lane,
+            "tenant": self.tenant,
+            "shed_level": self.shed_level,
+        })
+
+
 def _to_dense_f32(obj: Any) -> np.ndarray:
     """Nested lists -> dense float32 ndarray, rejecting ragged/non-numeric."""
     try:

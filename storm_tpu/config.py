@@ -436,6 +436,105 @@ class TracingConfig:
 
 
 @dataclass
+class QosConfig:
+    """Admission control & QoS: per-tenant token-bucket rate limiting at the
+    spout edge, weighted priority lanes with earliest-deadline-first batch
+    formation in the inference operator, and an adaptive load-shedding
+    controller that drops best-effort traffic *before* the autoscaler
+    reacts (scale-out takes seconds; shedding takes one control step).
+
+    Off by default: ``enabled=False`` keeps every hot path untouched — no
+    record classification, no extra tuple field, FIFO batch formation.
+
+    A record's tenant and lane ride on its broker key, ``tenant:lane``
+    (both optional): ``b"gold:high"`` is tenant *gold* in lane *high*,
+    ``b"gold"`` is tenant *gold* in ``default_lane``, and a key-less
+    record is tenant = its topic, lane = ``default_lane``.
+    """
+
+    enabled: bool = False
+    # Priority lanes, highest priority first. Keys naming an unknown lane
+    # (or no lane at all) fall into ``default_lane``.
+    lanes: tuple = ("high", "normal", "best_effort")
+    default_lane: str = "normal"
+    # Per-lane delivery deadlines (ms after broker append), aligned with
+    # ``lanes``: batch formation is earliest-deadline-first over these, so
+    # a fresh high-deadline record preempts queued best-effort ones
+    # instead of FIFO-queuing behind them.
+    lane_deadline_ms: tuple = (50.0, 200.0, 1000.0)
+    # Token-bucket admission at the spout edge: records/sec per tenant
+    # (0 = unlimited). ``tenant_rates`` overrides the default per tenant
+    # id. Each spout task gets an even split of the tenant's rate (static
+    # partition assignment spreads a tenant's records across tasks).
+    tenant_rate: float = 0.0
+    tenant_burst_s: float = 1.0  # bucket depth, in seconds of rate
+    tenant_rates: dict = field(default_factory=dict)
+    # Load-shedding controller: cadence + signal thresholds + hysteresis.
+    # A signal is *hot* when above its threshold; ``shed_hot_steps``
+    # consecutive hot intervals raise the shed level by one,
+    # ``shed_calm_steps`` consecutive calm intervals (every signal below
+    # half its threshold) lower it. Level N sheds the N lowest-priority
+    # lanes; the top lane is never shed.
+    shed_interval_s: float = 1.0
+    shed_inbox_frac: float = 0.5   # inference inbox occupancy fraction
+    shed_wait_ms: float = 0.0      # batch-wait p95 threshold (0 = off)
+    shed_breach_rate: float = 1.0  # sink SLO breaches/sec (needs tracing.slo_ms)
+    shed_hot_steps: int = 2
+    shed_calm_steps: int = 5
+    # Graceful degradation for shed traffic: "" rejects with a typed
+    # ``overloaded`` record on the output topic (fast, never times out);
+    # a model registry name routes shed lanes to that (cheaper) engine
+    # instead of rejecting.
+    degrade_model: str = ""
+
+    def __post_init__(self) -> None:
+        self.lanes = tuple(str(lane) for lane in self.lanes)
+        self.lane_deadline_ms = tuple(float(x) for x in self.lane_deadline_ms)
+        if not self.lanes or len(set(self.lanes)) != len(self.lanes):
+            raise ValueError("qos.lanes must be non-empty and unique")
+        if len(self.lane_deadline_ms) != len(self.lanes):
+            raise ValueError(
+                f"qos.lane_deadline_ms has {len(self.lane_deadline_ms)} "
+                f"entries for {len(self.lanes)} lanes")
+        if self.default_lane not in self.lanes:
+            raise ValueError(
+                f"qos.default_lane {self.default_lane!r} not in qos.lanes")
+        if self.shed_interval_s <= 0:
+            raise ValueError("qos.shed_interval_s must be > 0")
+        if self.shed_hot_steps < 1 or self.shed_calm_steps < 1:
+            raise ValueError("qos shed hot/calm steps must be >= 1")
+
+    # ---- lane helpers (one definition shared by spout/operator/shedder) ---
+
+    def lane_index(self, lane: Optional[str]) -> int:
+        """Priority index of ``lane`` (0 = highest); unknown lanes get the
+        default lane's index."""
+        try:
+            return self.lanes.index(lane)
+        except ValueError:
+            return self.lanes.index(self.default_lane)
+
+    def deadline_for(self, lane: Optional[str]) -> float:
+        return self.lane_deadline_ms[self.lane_index(lane)]
+
+    @property
+    def max_shed_level(self) -> int:
+        """Highest useful shed level: every lane but the top one shed."""
+        return len(self.lanes) - 1
+
+    def shed_eligible(self, lane: Optional[str], level: int) -> bool:
+        """Does shed ``level`` drop ``lane``? Level N sheds the N
+        lowest-priority lanes; the top lane never sheds."""
+        if level <= 0:
+            return False
+        shed_from = len(self.lanes) - min(int(level), self.max_shed_level)
+        return self.lane_index(lane) >= shed_from
+
+    def rate_for(self, tenant: str) -> float:
+        return float(self.tenant_rates.get(tenant, self.tenant_rate))
+
+
+@dataclass
 class PipelineConfig:
     """One model pipeline (spout -> inference -> sink) inside a multi-model
     topology: several of these share one process and one TPU slice
@@ -485,6 +584,7 @@ class Config:
     broker: BrokerConfig = field(default_factory=BrokerConfig)
     control: ControlConfig = field(default_factory=ControlConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    qos: QosConfig = field(default_factory=QosConfig)
     # Multi-model topology: non-empty => ``run`` builds one spout->infer->sink
     # chain per entry instead of the single-model DAG. TOML: [[pipelines]].
     pipelines: list = field(default_factory=list)

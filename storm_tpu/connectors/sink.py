@@ -122,6 +122,14 @@ class BrokerSink(Bolt):
         self._flight = getattr(context, "flight", None)
         tcfg = getattr(context.config, "tracing", None)
         self._slo_ms = float(getattr(tcfg, "slo_ms", 0.0) or 0.0)
+        # Counter twin of the (throttled) slo_breach flight event: every
+        # breach counts, so rates are computable — the load-shed
+        # controller's breach-rate signal reads this.
+        self._m_breach = context.metrics.counter(
+            context.component_id, "slo_breaches")
+        # Per-lane e2e histograms, built lazily the first time a tuple
+        # arrives carrying the QoS lane field (spout passthrough).
+        self._lane_latency: dict = {}
 
     async def _timed_send(self, topic: str, value: bytes,
                           key: Optional[bytes]) -> None:
@@ -223,12 +231,25 @@ class BrokerSink(Bolt):
                         t0 if t0 is not None else now, now,
                         attrs={"e2e_ms": round(ms, 3)})
                     self._tracer.finish(t.trace, ms)
-            if self._slo_ms and ms > self._slo_ms and self._flight is not None:
-                self._flight.event(
-                    "slo_breach", throttle_s=1.0,
-                    component=self.context.component_id,
-                    e2e_ms=round(ms, 3), slo_ms=self._slo_ms,
-                    trace_id=t.trace.trace_id if t.trace is not None else None)
+            if "qos_lane" in t.fields:
+                lane = t.get("qos_lane")
+                if lane:
+                    h = self._lane_latency.get(lane)
+                    if h is None:
+                        h = self._lane_latency[lane] = \
+                            self.context.metrics.histogram(
+                                self.context.component_id,
+                                f"e2e_latency_ms_{lane}")
+                    h.observe(ms)
+            if self._slo_ms and ms > self._slo_ms:
+                self._m_breach.inc()
+                if self._flight is not None:
+                    self._flight.event(
+                        "slo_breach", throttle_s=1.0,
+                        component=self.context.component_id,
+                        e2e_ms=round(ms, 3), slo_ms=self._slo_ms,
+                        trace_id=t.trace.trace_id if t.trace is not None
+                        else None)
         self.collector.ack(t)
 
     async def flush(self) -> None:

@@ -63,11 +63,19 @@ class BrokerSpout(Spout):
         fetch_size: int = 256,
         chunk: int = 0,
         scheme: str = "string",
+        qos=None,
     ) -> None:
         self.broker = broker
         self.topic = topic
         self.offsets_cfg = offsets or OffsetsConfig()
         self.fetch_size = fetch_size
+        # QosConfig (config.py) or None. When enabled, each record is
+        # classified from its broker key (``tenant:lane``) and run through
+        # the spout-edge admission controller (storm_tpu.qos.admission);
+        # the lane rides downstream as the declared ``qos_lane`` field.
+        # A ctor arg (not read from context.config at open()) because
+        # declare_output_fields() runs at topology build/validation time.
+        self.qos = qos if (qos is not None and qos.enabled) else None
         # chunk > 1: emit up to `chunk` consecutive records as ONE tuple
         # (value = list of payloads). Same wire contract, one ledger entry
         # and one executor hop per chunk instead of per record — the
@@ -89,7 +97,13 @@ class BrokerSpout(Spout):
         """Per-task instance sharing the broker handle (the broker is a
         shared external resource, not per-task state)."""
         return type(self)(self.broker, self.topic, self.offsets_cfg,
-                          self.fetch_size, self.chunk, self.scheme)
+                          self.fetch_size, self.chunk, self.scheme,
+                          self.qos)
+
+    def declare_output_fields(self):
+        if self.qos is not None:
+            return {"default": ("message", "qos_lane")}
+        return {"default": ("message",)}
 
     def open(self, context: TopologyContext, collector: OutputCollector) -> None:
         super().open(context, collector)
@@ -97,6 +111,16 @@ class BrokerSpout(Spout):
         # Cached once: _mint_trace runs per emitted record, so the tracer
         # lookup must not be a per-record getattr chain.
         self._tracer = getattr(context, "tracer", None)
+        # QoS admission (per task; the configured tenant rate is split
+        # across spout tasks inside the controller).
+        if self.qos is not None:
+            from storm_tpu.qos.admission import AdmissionController
+
+            self._admission = AdmissionController(
+                self.qos, parallelism=context.parallelism,
+                metrics=context.metrics)
+        else:
+            self._admission = None
         # Network-backed brokers (KafkaWireBroker) set blocking=True: their
         # fetches/commits run on worker threads, never on the event loop.
         self._blocking = bool(getattr(self.broker, "blocking", False))
@@ -311,6 +335,16 @@ class BrokerSpout(Spout):
                 records = self.broker.fetch(self.topic, p, pos, size)
             if not records:
                 continue
+            records = list(records)
+            last_off = records[-1].offset
+            if self._admission is not None:
+                records = self._admit_records(records)
+                if not records:
+                    # Whole fetch throttled/shed: the cursor still
+                    # advances — dropping with progress IS the admission
+                    # policy (same shape as the max_behind freshness drop).
+                    self.positions[p] = last_off + 1
+                    return True
             # Emit FIRST, advance the cursor after: an exception mid-loop
             # (executor catches and retries next_tuple) must re-fetch the
             # unemitted tail — duplicates are the safe direction for
@@ -323,21 +357,58 @@ class BrokerSpout(Spout):
                 # One full-size fetch (one broker round trip), sliced into
                 # chunk tuples — NOT one fetch per chunk, which would
                 # multiply network fetches for blocking brokers.
-                records = list(records)
                 for i in range(0, len(records), self.chunk):
-                    await self._emit_chunk(records[i : i + self.chunk])
-                    if self._txn_mode:
-                        self._part_inflight[p] = \
-                            self._part_inflight.get(p, 0) + 1
+                    # Under QoS a chunk must be lane-homogeneous (one tuple
+                    # carries one qos_lane value), so the slice is split by
+                    # lane; without QoS the slice ships whole.
+                    for group in self._lane_groups(records[i : i + self.chunk]):
+                        await self._emit_chunk(group)
+                        if self._txn_mode:
+                            self._part_inflight[p] = \
+                                self._part_inflight.get(p, 0) + 1
             else:
                 for rec in records:
                     await self._emit(rec)
                     if self._txn_mode:
                         self._part_inflight[p] = \
                             self._part_inflight.get(p, 0) + 1
-            self.positions[p] = records[-1].offset + 1
+            self.positions[p] = last_off + 1
             return True
         return False
+
+    # ---- QoS admission -------------------------------------------------------
+
+    def _admit_records(self, records: "list[Record]") -> "list[Record]":
+        """Run each fetched record through the admission controller;
+        non-admitted records are dropped (their offsets are skipped by the
+        cursor advance in next_tuple) and counted by the controller."""
+        admitted = []
+        for rec in records:
+            tenant, lane = self._admission.classify(rec.key, self.topic)
+            ok, _reason = self._admission.admit(tenant, lane)
+            if ok:
+                admitted.append(rec)
+            else:
+                self.dropped += 1
+        return admitted
+
+    def _lane_of(self, rec: Record) -> Optional[str]:
+        if self._admission is None:
+            return None
+        return self._admission.classify(rec.key, self.topic)[1]
+
+    def _lane_groups(self, records: "list[Record]"):
+        """Split one chunk slice into lane-homogeneous groups, highest
+        priority first (classification is deterministic from the record
+        key, so replayed chunks re-derive the same lane)."""
+        if self._admission is None:
+            yield records
+            return
+        groups: Dict[str, list] = {}
+        for rec in records:
+            groups.setdefault(self._lane_of(rec), []).append(rec)
+        for lane in sorted(groups, key=self.qos.lane_index):
+            yield groups[lane]
 
     def _append_root_ts(self, rec: Record) -> float:
         """E2E ingress clock = broker APPEND time, not spout-emit time.
@@ -391,8 +462,13 @@ class BrokerSpout(Spout):
         msg_id = ("c", first.partition, first.offset, last.offset)
         self.pending[msg_id] = records
         root_ts = self._append_root_ts(first)
+        vals = [[self._scheme_value(r.value) for r in records]]
+        if self.qos is not None:
+            # Chunks are lane-homogeneous (next_tuple groups by lane), so
+            # the first record's lane speaks for the whole tuple.
+            vals.append(self._lane_of(first))
         await self.collector.emit(
-            Values([[self._scheme_value(r.value) for r in records]]),
+            Values(vals),
             msg_id=msg_id,
             # Oldest record in the chunk: its queueing is the one that counts.
             root_ts=root_ts,
@@ -406,8 +482,11 @@ class BrokerSpout(Spout):
         msg_id = (rec.partition, rec.offset)
         self.pending[msg_id] = rec
         root_ts = self._append_root_ts(rec)
+        vals = [self._scheme_value(rec.value)]
+        if self.qos is not None:
+            vals.append(self._lane_of(rec))
         await self.collector.emit(
-            Values([self._scheme_value(rec.value)]),
+            Values(vals),
             msg_id=msg_id,
             root_ts=root_ts,
             origins=frozenset({(self.topic, rec.partition, rec.offset + 1)}),

@@ -33,6 +33,10 @@ class BatchItem:
     # the start of a sampled record's queue_wait trace span (the operator's
     # _trace_batch — batcher entry to device dispatch).
     enq: float = 0.0
+    # QoS priority lane (None outside QoS mode). Carried so the EDF lane
+    # batcher (storm_tpu.qos.lanes) and per-lane metrics can attribute the
+    # item without re-deriving it from the tuple.
+    lane: Optional[str] = None
 
 
 @dataclass

@@ -24,15 +24,19 @@ replay (the reference swallowed inference errors)."""
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import time
 from typing import Optional, Sequence, Set
 
-from storm_tpu.api.schema import DeadLetter, SchemaError, decode_instances, encode_predictions
+import numpy as np
+
+from storm_tpu.api.schema import (
+    DeadLetter, Overloaded, SchemaError, decode_instances, encode_predictions)
 from storm_tpu.config import BatchConfig, Config, ModelConfig, ShardingConfig
 from storm_tpu.infer.batcher import Batch, MicroBatcher
 from storm_tpu.infer.engine import InferenceEngine, shared_engine
 from storm_tpu.runtime.base import Bolt, OutputCollector, TopologyContext
-from storm_tpu.runtime.tracing import span
+from storm_tpu.runtime.tracing import NOT_SAMPLED, span
 from storm_tpu.runtime.tuples import Tuple, Values
 
 
@@ -66,6 +70,7 @@ class InferenceBolt(Bolt):
         engine: Optional[InferenceEngine] = None,
         warmup: bool = True,
         passthrough: Sequence[str] = (),
+        qos=None,
     ) -> None:
         self.model_cfg = model or ModelConfig()
         self.batch_cfg = batch or BatchConfig()
@@ -76,11 +81,16 @@ class InferenceBolt(Bolt):
         # streams). How a DRPC request id rides through the operator —
         # Storm's LinearDRPCTopologyBuilder threads return-info the same way.
         self.passthrough = tuple(passthrough)
+        # QosConfig (config.py) or None. When enabled: earliest-deadline-
+        # first batch formation (storm_tpu.qos.lanes) instead of FIFO, and
+        # shed-eligible tuples are degraded/rejected while the shed level
+        # (gauge ("qos", "shed_level")) is raised.
+        self.qos = qos if (qos is not None and qos.enabled) else None
 
     def clone(self) -> "InferenceBolt":
         return InferenceBolt(
             self.model_cfg, self.batch_cfg, self.sharding_cfg, self._engine,
-            self._warmup, self.passthrough
+            self._warmup, self.passthrough, self.qos
         )
 
     def declare_output_fields(self):
@@ -117,7 +127,12 @@ class InferenceBolt(Bolt):
         )
         if self._warmup and not getattr(self, "_prewarmed", False):
             self.engine.warmup()
-        self.batcher = MicroBatcher(self.batch_cfg)
+        if self.qos is not None:
+            from storm_tpu.qos.lanes import LaneBatcher
+
+            self.batcher = LaneBatcher(self.batch_cfg, self.qos)
+        else:
+            self.batcher = MicroBatcher(self.batch_cfg)
         self._flush_task: Optional[asyncio.Task] = None
         self._inflight: Set[asyncio.Task] = set()
         self._dispatch_sem = asyncio.Semaphore(
@@ -139,6 +154,26 @@ class InferenceBolt(Bolt):
         self._m_ingest = m.histogram(cid, "ingest_lag_ms")  # append -> bolt
         self._m_batch_wait = m.histogram(cid, "batch_wait_ms")  # in batcher
         self._m_disp_wait = m.histogram(cid, "dispatch_wait_ms")  # sem queue
+        # QoS: the shed level is read per tuple, so cache the gauge (the
+        # LoadShedController publishes through the same registry); the
+        # degrade engine (cheaper model variant for shed traffic) shares
+        # the process-level engine cache and compiles lazily on first use.
+        if self.qos is not None:
+            self._shed_gauge = m.gauge("qos", "shed_level")
+            self._m_shed = m.counter(cid, "shed_rejected")
+            self._m_degraded = m.counter(cid, "shed_degraded")
+            if self.qos.degrade_model:
+                self._degrade_engine = shared_engine(
+                    dataclasses.replace(
+                        self.model_cfg, name=self.qos.degrade_model),
+                    self.sharding_cfg, self.batch_cfg)
+            else:
+                self._degrade_engine = None
+            # One degrade call in flight at a time: the degrade path is
+            # unbatched (per shed tuple), so it must not be able to starve
+            # the primary engine's thread pool under overload — when the
+            # slot is busy, shed traffic falls back to typed rejection.
+            self._degrade_sem = asyncio.Semaphore(1)
         # Distributed tracing + flight recorder (runtime/tracing.py).
         self._tracer = getattr(context, "tracer", None)
         self._flight = getattr(context, "flight", None)
@@ -228,20 +263,33 @@ class InferenceBolt(Bolt):
             # (broker queueing + spout fetch/decode + inter-operator hop).
             self._m_ingest.observe((time.perf_counter() - t.root_ts) * 1e3)
         payload = t.get("message")
+        lane = t.get("qos_lane", None) if self.qos is not None else None
+        if self.qos is not None:
+            level = int(self._shed_gauge.value)
+            if level > 0 and self.qos.shed_eligible(lane, level):
+                # Shed BEFORE decode: the whole point is spending nothing
+                # on traffic we will not serve at full fidelity.
+                await self._shed_tuple(t, payload, lane, level)
+                return
         if isinstance(payload, (list, tuple)):
-            await self._execute_chunk(t, payload)
+            await self._execute_chunk(t, payload, lane)
             return
         try:
             inst = self._decode_checked(payload, t.root_ts)
         except SchemaError as e:
             await self._dead_letter(t, payload, str(e))
             return
-        batch = self.batcher.add(t, inst.data, ts=t.root_ts or None)
+        batch = self._batcher_add(t, inst.data, t.root_ts or None, lane)
         if batch is not None:
             await self._dispatch(batch)
         self._kick_flush()
 
-    async def _execute_chunk(self, t: Tuple, payloads) -> None:
+    def _batcher_add(self, item, data, ts, lane):
+        if self.qos is not None:
+            return self.batcher.add(item, data, ts=ts, lane=lane)
+        return self.batcher.add(item, data, ts=ts)
+
+    async def _execute_chunk(self, t: Tuple, payloads, lane=None) -> None:
         handle = _ChunkHandle(t, len(payloads))
         for payload in payloads:
             try:
@@ -252,7 +300,8 @@ class InferenceBolt(Bolt):
                 await self._emit_dead_letter(t, payload, str(e))
                 handle.done(True, self.collector)
                 continue
-            batch = self.batcher.add(handle, inst.data, ts=t.root_ts or None)
+            batch = self._batcher_add(handle, inst.data, t.root_ts or None,
+                                      lane)
             if batch is not None:
                 await self._dispatch(batch)
         self._kick_flush()
@@ -263,6 +312,70 @@ class InferenceBolt(Bolt):
         at InferenceBolt.java:92-99 is the anti-pattern this replaces)."""
         await self._emit_dead_letter(t, payload, error)
         self.collector.ack(t)
+
+    # ---- QoS shedding --------------------------------------------------------
+
+    async def _shed_tuple(self, t: Tuple, payload, lane, level: int) -> None:
+        """Graceful degradation for a shed-eligible tuple while the shed
+        level is raised: serve it on the cheaper degrade engine when one is
+        configured and free, otherwise answer immediately with a typed
+        ``Overloaded`` record — either way the client gets a parseable
+        response *now* instead of a timeout, and the tuple acks (shedding
+        must never trigger replay: replaying rejected load is more load)."""
+        payloads = payload if isinstance(payload, (list, tuple)) else [payload]
+        degraded = False
+        if self._degrade_engine is not None and not self._degrade_sem.locked():
+            degraded = await self._degrade(t, payloads)
+        if not degraded:
+            msg = Overloaded(lane=lane or "", shed_level=level).to_json()
+            for _ in payloads:
+                await self.collector.emit(
+                    Values([msg, *self._extras(t)]), anchors=[t])
+            self._m_shed.inc(len(payloads))
+        action = "degrade" if degraded else "reject"
+        if self._flight is not None:
+            self._flight.event(
+                "shed_" + action, throttle_s=1.0,
+                component=self.context.component_id,
+                lane=lane, level=level, records=len(payloads))
+        ctx = t.trace
+        if (ctx is not None and ctx is not NOT_SAMPLED
+                and self._tracer is not None and self._tracer.active):
+            now = time.perf_counter()
+            self._tracer.record(
+                ctx, "qos_shed", self.context.component_id,
+                t.root_ts or now, now,
+                attrs={"lane": lane or "", "level": level, "action": action})
+        self.collector.ack(t)
+
+    async def _degrade(self, t: Tuple, payloads) -> bool:
+        """Run shed traffic on the cheaper model variant, unbatched (one
+        predict per shed tuple, single slot — see the semaphore note in
+        prepare). Returns False (caller rejects instead) on any decode or
+        shape mismatch: the degrade path must stay cheap and infallible."""
+        eng = self._degrade_engine
+        try:
+            arrs = [decode_instances(p, ts=t.root_ts).data for p in payloads]
+        except SchemaError:
+            return False
+        x = np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+        if tuple(x.shape[1:]) != eng.input_shape:
+            return False
+        async with self._degrade_sem:
+            try:
+                out = await asyncio.to_thread(eng.predict, x)
+            except Exception as e:
+                self.collector.report_error(e)
+                return False
+        i = 0
+        for arr in arrs:
+            n = arr.shape[0]
+            msg = encode_predictions(out[i:i + n])
+            i += n
+            await self.collector.emit(
+                Values([msg, *self._extras(t)]), anchors=[t])
+        self._m_degraded.inc(len(payloads))
+        return True
 
     # ---- batching / dispatch -------------------------------------------------
 

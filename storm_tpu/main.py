@@ -44,17 +44,23 @@ def build_standard_topology(cfg: Config, broker):
     from storm_tpu.infer import InferenceBolt
     from storm_tpu.runtime import TopologyBuilder
 
+    # QoS (config.qos): the spout classifies/admits records and emits the
+    # lane; the operator carries it through to the sink (per-lane e2e
+    # histograms) via passthrough.
+    qos = cfg.qos if cfg.qos.enabled else None
     tb = TopologyBuilder()
     tb.set_spout(
         "kafka-spout",
         BrokerSpout(broker, cfg.broker.input_topic, cfg.offsets,
                     chunk=cfg.topology.spout_chunk,
-                    scheme=cfg.topology.spout_scheme),
+                    scheme=cfg.topology.spout_scheme,
+                    qos=qos),
         parallelism=cfg.topology.spout_parallelism,
     )
     tb.set_bolt(
         "inference-bolt",
-        InferenceBolt(cfg.model, cfg.batch, cfg.sharding),
+        InferenceBolt(cfg.model, cfg.batch, cfg.sharding, qos=qos,
+                      passthrough=("qos_lane",) if qos else ()),
         parallelism=cfg.topology.inference_parallelism,
     ).shuffle_grouping("kafka-spout")
     tb.set_bolt(
@@ -84,6 +90,7 @@ def build_multi_model_topology(cfg: Config, broker):
 
     if not cfg.pipelines:
         raise ValueError("build_multi_model_topology needs cfg.pipelines")
+    qos = cfg.qos if cfg.qos.enabled else None  # shared across pipelines
     tb = TopologyBuilder()
     for p in cfg.pipelines:
         spout_id = f"{p.name}-spout"
@@ -92,12 +99,14 @@ def build_multi_model_topology(cfg: Config, broker):
             spout_id,
             BrokerSpout(broker, p.input_topic, p.offsets,
                         chunk=p.spout_chunk or cfg.topology.spout_chunk,
-                        scheme=p.spout_scheme or cfg.topology.spout_scheme),
+                        scheme=p.spout_scheme or cfg.topology.spout_scheme,
+                        qos=qos),
             parallelism=p.spout_parallelism,
         )
         tb.set_bolt(
             infer_id,
-            InferenceBolt(p.model, p.batch, p.sharding),
+            InferenceBolt(p.model, p.batch, p.sharding, qos=qos,
+                          passthrough=("qos_lane",) if qos else ()),
             parallelism=p.inference_parallelism,
         ).shuffle_grouping(spout_id)
         tb.set_bolt(
@@ -165,6 +174,24 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
 
         rt.add_metrics_consumer(JsonLinesConsumer(metrics_file),
                                 interval_s=metrics_interval_s)
+    # One control pair per inference/sink chain: the standard topology has
+    # one; a multi-model topology has one per pipeline.
+    pairs = (
+        [(f"{p.name}-inference", f"{p.name}-sink") for p in cfg.pipelines]
+        if cfg.pipelines
+        else [("inference-bolt", "kafka-bolt")]
+    )
+    shedders = []
+    if cfg.qos.enabled and not topology_file:
+        from storm_tpu.qos import LoadShedController, ShedPolicy
+
+        # The shed loop runs faster than the autoscaler (1 s vs 5 s
+        # default) and is handed to it below: shed first, scale second.
+        shedders = [
+            LoadShedController(
+                rt, ShedPolicy.from_qos(cfg.qos, infer_id, sink_id)).start()
+            for infer_id, sink_id in pairs
+        ]
     scalers = []
     if autoscale_target_ms > 0:
         from storm_tpu.runtime.autoscale import (
@@ -173,15 +200,9 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
             AutoscalePolicy,
         )
 
-        # One autoscaler per inference/sink pair: the standard topology has
-        # one; a multi-model topology has one per pipeline. The inference
-        # operator fronts a batching accelerator, so ITS policy carries the
-        # measured inversion cap (not the global dataclass default).
-        pairs = (
-            [(f"{p.name}-inference", f"{p.name}-sink") for p in cfg.pipelines]
-            if cfg.pipelines
-            else [("inference-bolt", "kafka-bolt")]
-        )
+        # The inference operator fronts a batching accelerator, so ITS
+        # policy carries the measured inversion cap (not the global
+        # dataclass default).
         scalers = [
             Autoscaler(
                 rt,
@@ -192,8 +213,9 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
                     low_ms=autoscale_target_ms / 4,
                     max_parallelism=ACCEL_MAX_PARALLELISM,
                 ),
+                shedder=shedders[i] if shedders else None,
             ).start()
-            for infer_id, sink_id in pairs
+            for i, (infer_id, sink_id) in enumerate(pairs)
         ]
     ui = None
     if ui_port >= 0:
@@ -205,6 +227,7 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
                             auth_token=cfg.control.resolve_token()).start()
     print(f"topology {name!r} running "
           f"(model={desc}, broker={cfg.broker.kind}"
+          f"{', qos' if shedders else ''}"
           f"{', autoscaling' if scalers else ''}"
           f"{f', ui http://127.0.0.1:{ui.port}' if ui else ''})",
           file=sys.stderr)
@@ -222,6 +245,8 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
         await ui.stop()
     for scaler in scalers:
         await scaler.stop()
+    for shedder in shedders:
+        await shedder.stop()
     await rt.deactivate()
     await rt.drain(timeout_s=30)
     snap = rt.metrics.snapshot()

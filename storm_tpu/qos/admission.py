@@ -1,0 +1,124 @@
+"""Spout-edge admission control: tenant/lane classification + token buckets.
+
+Classification rides the broker record *key* (``tenant:lane``), so no
+payload parse happens at the edge — the spout already has the key bytes in
+hand. Quota accounting is a classic token bucket per tenant: capacity
+``rate * burst_s`` tokens, refilled continuously at ``rate``/s; a record
+is admitted iff a token is available. The configured per-tenant rate is
+split evenly across spout tasks (static partition assignment spreads a
+tenant's records across tasks, so task-local buckets approximate the
+global quota without cross-task coordination).
+
+Non-admitted records are dropped with the cursor advanced — the same
+policy shape as the spout's ``max_behind`` freshness drop — and counted
+per tenant (``qos_throttled_<tenant>``). Edge shedding (dropping whole
+lanes when the shed controller raises its level) also lives here so the
+spout has a single admit() verdict to consult.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+from storm_tpu.config import QosConfig
+
+
+class TokenBucket:
+    """Continuous-refill token bucket (rate/s, capacity ``burst``)."""
+
+    __slots__ = ("rate", "burst", "tokens", "_last")
+
+    def __init__(self, rate: float, burst: float,
+                 now: Optional[float] = None) -> None:
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self.tokens = self.burst  # start full: a fresh tenant gets its burst
+        self._last = now if now is not None else time.monotonic()
+
+    def try_take(self, n: float = 1.0, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.monotonic()
+        # Clamp at zero: a caller clock earlier than ours (mixed clock
+        # sources, or an injected test clock) must not DRAIN the bucket.
+        self.tokens = min(self.burst,
+                          self.tokens + max(0.0, now - self._last) * self.rate)
+        self._last = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+class AdmissionController:
+    """Per-spout-task admission: classify a record, then admit or drop.
+
+    Built by ``BrokerSpout.open()`` when ``qos.enabled``; stateless across
+    restarts (buckets refill from full — a restarted spout briefly
+    over-admits one burst rather than stalling a tenant).
+    """
+
+    def __init__(self, qos: QosConfig, parallelism: int = 1,
+                 metrics=None, component: str = "qos") -> None:
+        self.qos = qos
+        self.parallelism = max(1, int(parallelism))
+        self._buckets: dict = {}
+        self._metrics = metrics
+        self._component = component
+        # Shed level is published by the LoadShedController through the
+        # shared registry gauge; reading .value is a plain attribute load.
+        self._shed = (metrics.gauge("qos", "shed_level")
+                      if metrics is not None else None)
+
+    # ---- classification ------------------------------------------------------
+
+    def classify(self, key: Optional[bytes],
+                 topic: str = "") -> Tuple[str, str]:
+        """``(tenant, lane)`` for one record. Key format ``tenant:lane``;
+        missing pieces default to the topic (tenant) / default lane."""
+        qos = self.qos
+        if not key:
+            return (topic or "default", qos.default_lane)
+        text = key.decode("utf-8", "replace") if isinstance(
+            key, (bytes, bytearray)) else str(key)
+        tenant, sep, lane = text.partition(":")
+        if not sep or lane not in qos.lanes:
+            lane = qos.default_lane
+        return (tenant or (topic or "default"), lane)
+
+    # ---- admission -----------------------------------------------------------
+
+    def _bucket(self, tenant: str) -> Optional[TokenBucket]:
+        b = self._buckets.get(tenant)
+        if b is None:
+            rate = self.qos.rate_for(tenant)
+            if rate <= 0:
+                self._buckets[tenant] = b = None  # unlimited: cache the miss
+            else:
+                per_task = rate / self.parallelism
+                self._buckets[tenant] = b = TokenBucket(
+                    per_task, per_task * self.qos.tenant_burst_s)
+        return b
+
+    def admit(self, tenant: str, lane: str,
+              now: Optional[float] = None) -> Tuple[bool, str]:
+        """``(admitted, reason)``: reason is ``"ok"``, ``"throttled"``
+        (tenant over quota), or ``"shed"`` (lane dropped at the edge by
+        the current shed level)."""
+        if self._shed is not None and self.qos.shed_eligible(
+                lane, int(self._shed.value)):
+            self._count("shed", tenant, lane)
+            return False, "shed"
+        bucket = self._bucket(tenant)
+        if bucket is not None and not bucket.try_take(1.0, now):
+            self._count("throttled", tenant, lane)
+            return False, "throttled"
+        self._count("admitted", tenant, lane)
+        return True, "ok"
+
+    def _count(self, what: str, tenant: str, lane: str) -> None:
+        if self._metrics is None:
+            return
+        # Registry keys are (component, name); tenant/lane ride the name —
+        # prometheus_text sanitizes non-alnum chars, so these scrape clean.
+        self._metrics.counter(self._component, f"{what}_{tenant}").inc()
+        self._metrics.counter(self._component, f"{what}_lane_{lane}").inc()

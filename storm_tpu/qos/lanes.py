@@ -1,0 +1,94 @@
+"""Earliest-deadline-first batch formation (weighted priority lanes).
+
+Drop-in replacement for the FIFO :class:`~storm_tpu.infer.batcher.
+MicroBatcher` (same ``add``/``take_if_due``/``take_all``/``oldest_ts``
+surface, so the inference operator's dispatch machinery is unchanged).
+The difference is *selection*: pending records sit in a min-heap keyed by
+absolute deadline (broker-append time + the lane's ``lane_deadline_ms``),
+and a take pops at most ``max_batch`` instances in deadline order, leaving
+the rest pending. A fresh high-priority record therefore preempts queued
+best-effort ones — under backlog the best-effort tail waits, instead of a
+high-priority record FIFO-queuing behind it (BatchGen's deadline-aware
+batch-formation argument, PAPERS.md).
+
+Dispatch *timing* keeps the MicroBatcher contract — flush when full or
+when the oldest record has waited ``max_wait_ms`` — so enabling QoS does
+not change the latency floor of an unloaded topology.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from typing import Any, List, Optional
+
+import numpy as np
+
+from storm_tpu.config import BatchConfig, QosConfig
+from storm_tpu.infer.batcher import Batch, BatchItem
+
+
+class LaneBatcher:
+    def __init__(self, cfg: BatchConfig, qos: QosConfig) -> None:
+        self.cfg = cfg
+        self.qos = qos
+        # (deadline_s, seq, BatchItem); seq breaks ties FIFO within a lane.
+        self._heap: List[tuple] = []
+        self._seq = 0
+        self._count = 0
+
+    def __len__(self) -> int:
+        return self._count
+
+    @property
+    def oldest_ts(self) -> Optional[float]:
+        """Oldest *arrival* ts among pending items (deadline order is for
+        selection; the max_wait_ms dispatch bound is still age-based)."""
+        if not self._heap:
+            return None
+        return min(entry[2].ts for entry in self._heap)
+
+    def add(self, payload: Any, data: np.ndarray,
+            ts: Optional[float] = None,
+            lane: Optional[str] = None) -> Optional[Batch]:
+        """Add one record (n_i instances). Returns a deadline-ordered Batch
+        once ``max_batch`` instances are pending, else None. Unlike the
+        FIFO batcher, later-deadline items beyond max_batch stay pending
+        for the next take instead of forcing an immediate flush."""
+        now = time.perf_counter()
+        base = ts if ts is not None else now
+        deadline = base + self.qos.deadline_for(lane) / 1e3
+        item = BatchItem(payload, data, base, now, lane)
+        heapq.heappush(self._heap, (deadline, self._seq, item))
+        self._seq += 1
+        self._count += data.shape[0]
+        if self._count >= self.cfg.max_batch:
+            return self._take()
+        return None
+
+    def take_if_due(self, now: Optional[float] = None) -> Optional[Batch]:
+        if not self._heap:
+            return None
+        now = now if now is not None else time.perf_counter()
+        oldest = self.oldest_ts
+        if oldest is not None and (now - oldest) * 1e3 >= self.cfg.max_wait_ms:
+            return self._take()
+        return None
+
+    def take_all(self) -> Optional[Batch]:
+        return self._take() if self._heap else None
+
+    def _take(self) -> Batch:
+        """Pop earliest-deadline items up to max_batch instances (always at
+        least one item, so an oversized single record still ships — the
+        engine pads per-shape rather than crash)."""
+        items: List[BatchItem] = []
+        size = 0
+        while self._heap:
+            n = self._heap[0][2].data.shape[0]
+            if items and size + n > self.cfg.max_batch:
+                break
+            items.append(heapq.heappop(self._heap)[2])
+            size += n
+        self._count -= size
+        return Batch(items, size)

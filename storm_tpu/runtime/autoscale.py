@@ -67,9 +67,18 @@ class AutoscalePolicy:
 
 
 class Autoscaler:
-    def __init__(self, runtime, policy: Optional[AutoscalePolicy] = None) -> None:
+    def __init__(self, runtime, policy: Optional[AutoscalePolicy] = None,
+                 shedder=None) -> None:
         self.rt = runtime
         self.policy = policy or AutoscalePolicy()
+        # Shed-first/scale-second (storm_tpu.qos.shedding): with a
+        # LoadShedController attached, the first scale-up is deferred until
+        # the shedder has reacted (level > 0) or stayed calm through one
+        # extra hot interval — cheap shedding gets a head start over
+        # expensive scale-out, and a transient spike the shedder absorbs
+        # never pays a rebalance at all.
+        self.shedder = shedder
+        self._deferred = 0
         self._task: Optional[asyncio.Task] = None
         self._calm = 0
         self._hot = 0
@@ -118,11 +127,24 @@ class Autoscaler:
         elif calm:
             self._calm += 1
             self._hot = 0
+            self._deferred = 0
         else:
             self._hot = 0
             self._calm = 0
+            self._deferred = 0
 
         if self._hot >= 2 and current < p.max_parallelism:
+            if (self.shedder is not None and self.shedder.level == 0
+                    and self._deferred < 1):
+                # Shed-first/scale-second: give the (faster) shed loop one
+                # interval to absorb the spike before paying a rebalance.
+                self._deferred += 1
+                log.info(
+                    "scale-up of %s deferred one interval (shedder level 0)",
+                    p.component)
+                self._flight("defer", current, current, p50, inbox_frac)
+                return None
+            self._deferred = 0
             new = current + 1
             log.info(
                 "scaling %s UP %d->%d (p50=%s ms, inbox=%.0f%%)",

@@ -392,6 +392,23 @@ class UIServer:
                     {"component": cid, "task": idx, "error": repr(err)}
                     for cid, idx, err in rt.errors
                 ]}
+            if action == "qos":
+                # Admission/shed state: the "qos" metrics component (shed
+                # level gauge, per-tenant/per-lane admission counters —
+                # present on dist views too via the merged snapshot) plus
+                # the local shed controller's decision ledger when one is
+                # attached (LoadShedController sets rt.qos).
+                if method != "GET":
+                    return 405, {"error": "use GET"}
+                snap = await asyncio.to_thread(rt.metrics.snapshot)
+                out = {"topology": rt.name, "qos": snap.get("qos", {})}
+                shedder = getattr(rt, "qos", None)
+                if shedder is not None:
+                    out["shed_level"] = shedder.level
+                    out["decisions"] = [
+                        {"direction": d, "from": a, "to": b}
+                        for d, a, b in shedder.decisions]
+                return 200, out
             if method != "POST":
                 return 405, {"error": "topology actions are POST"}
             return await self._action(rt, action, {**query, **body})

@@ -1,0 +1,49 @@
+"""Citation honesty for committed docs (round-6 satellite).
+
+Round 5 shipped README/PARITY rows citing ``SOAK_r05.json`` and
+``BENCH_SLO_r05.json`` — artifacts that were never committed. A cited
+artifact IS the evidence; citing a file that isn't in the tree is a
+false claim the reader can't audit. This test greps the prose docs for
+``*_rNN.json``-style artifact citations and fails on any that point at
+a file absent from the repo root, so a stale citation can never survive
+CI again.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+DOCS = ("README.md", "PARITY.md", "BENCH_NOTES.md")
+
+# BENCH_AUTOSCALE_CAP_r05.json, SOAK_r05.json, ACCURACY_TPU_r04.json, ...
+CITATION = re.compile(r"\b([A-Za-z][A-Za-z0-9_]*_r\d+\.json)\b")
+
+
+def _citations(doc: str):
+    text = (REPO / doc).read_text(encoding="utf-8")
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        for m in CITATION.finditer(line):
+            yield lineno, m.group(1)
+
+
+@pytest.mark.parametrize("doc", DOCS)
+def test_cited_artifacts_exist(doc):
+    missing = [f"{doc}:{lineno} cites {name}"
+               for lineno, name in _citations(doc)
+               if not (REPO / name).is_file()]
+    assert not missing, (
+        "docs cite artifact files that are not committed:\n  "
+        + "\n  ".join(missing)
+        + "\n(cite only present artifacts, or state that no artifact "
+          "is committed)")
+
+
+def test_citation_regex_sees_the_docs():
+    """Guard the guard: if the artifact naming convention changes and the
+    regex goes blind, this fails instead of the main test silently
+    passing on zero citations."""
+    assert sum(1 for doc in DOCS for _ in _citations(doc)) >= 10
