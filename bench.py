@@ -30,6 +30,11 @@ import time
 
 import numpy as np
 
+# jax-free import (tracing pulls jax only inside device_trace): the stage
+# table below derives its device-substage rows from the same constant the
+# engine and operator use.
+from storm_tpu.runtime.tracing import DEVICE_SUBSTAGES
+
 BASELINE_IMGS_PER_SEC_PER_CHIP = 10_000 / 8  # north-star v5e-8 target, per chip
 
 
@@ -583,16 +588,23 @@ def run_latency_phase(produce_nth, out_size_fn, reset_hists, read_lat,
 
 
 #: (component, histogram, label) — the per-stage attribution of the
-#: append->deliver clock. Ordered as the record experiences them.
+#: append->deliver clock. Ordered as the record experiences them. The
+#: h2d/compute/d2h rows decompose ``device`` (the engine's split-phase
+#: pipeline timings), so they are excluded from the stage SUM — counting
+#: them next to device_ms would double that time.
 STAGES = [
     ("inference-bolt", "ingest_lag_ms", "ingest_to_bolt"),
     ("inference-bolt", "decode_ms", "decode"),
     ("inference-bolt", "batch_wait_ms", "batch_wait"),
     ("inference-bolt", "dispatch_wait_ms", "dispatch_queue"),
     ("inference-bolt", "device_ms", "device"),
+    *[("inference-bolt", key, label) for key, label in DEVICE_SUBSTAGES],
     ("inference-bolt", "encode_ms", "encode"),
     ("kafka-bolt", "produce_ms", "produce"),
 ]
+
+#: Labels that re-attribute time already counted by another stage row.
+SUBSTAGE_LABELS = frozenset(label for _, label in DEVICE_SUBSTAGES)
 
 
 def read_stage_p50s(cluster, name) -> dict:
@@ -613,7 +625,7 @@ def reset_stage_hists(cluster, name) -> None:
 
 def run_latency_pass(cluster, args, cfg, buckets, topo_name,
                      framework_only=False, seconds=None,
-                     throughput_msgs=0) -> dict:
+                     throughput_msgs=0, pipeline_depth=None) -> dict:
     """ONE latency-protocol pass over a fresh topology: calibrate, offer
     under the backlog guard, report e2e percentiles + per-stage p50s.
 
@@ -629,12 +641,21 @@ def run_latency_pass(cluster, args, cfg, buckets, topo_name,
 
     label = "framework-only" if framework_only else "device-path"
     broker = MemoryBroker(default_partitions=4)
+    if pipeline_depth is None:
+        pipeline_depth = getattr(args, "pipeline_depth", None)
+    batch_kw = {}
+    if pipeline_depth is not None:
+        # --pipeline-compare pins the engine's split-phase depth per pass
+        # (0 = the serialized pre-pipeline predict); default passes take
+        # the BatchConfig default.
+        batch_kw["pipeline_depth"] = pipeline_depth
     batch_cfg = BatchConfig(
         max_batch=args.max_batch or cfg["max_batch"],
         max_wait_ms=args.max_wait_ms,
         buckets=buckets,
         max_inflight=args.inflight or 2,
         eager=args.eager,
+        **batch_kw,
     )
     engine = (NullEngine(cfg["input_shape"], cfg["num_classes"])
               if framework_only else None)
@@ -723,9 +744,11 @@ def run_latency_breakdown(args) -> dict:
     fw_p50 = fw.get("p50_ms")
     dev_stages = dev["stages_p50_ms"]
     # Sum of in-bolt/sink stage p50s, vs e2e p50: the unaccounted
-    # remainder is inter-operator hops + ack plumbing.
+    # remainder is inter-operator hops + ack plumbing. Device substages
+    # (h2d/compute/d2h) re-attribute time device_ms already counts.
     dev["stage_sum_ex_ingest_ms"] = round(
-        sum(v for k, v in dev_stages.items() if k != "ingest_to_bolt"), 1)
+        sum(v for k, v in dev_stages.items()
+            if k != "ingest_to_bolt" and k not in SUBSTAGE_LABELS), 1)
     return {
         "metric": f"{cfg['metric']}_framework_only_p50_ms",
         "value": fw_p50,
@@ -738,6 +761,84 @@ def run_latency_breakdown(args) -> dict:
         "device_path": dev,
         "chips": n_dev,
         "config": f"{args.config}+latency-breakdown",
+    }
+
+
+def run_pipeline_compare(args) -> dict:
+    """``--pipeline-compare``: the split-phase pipeline's claim as one
+    artifact. Two protocol-identical device-path passes on the same host
+    in the same process (same code-version stamp, same capture session):
+
+    1. serialized baseline — ``pipeline_depth=0``, the pre-pipeline
+       engine (pad -> cast -> device_put -> fwd -> fetch under one lock,
+       one batch at a time);
+    2. pipelined — dispatch/fetch split with a bounded in-flight ring, so
+       H2D of batch N+1 overlaps compute of batch N and D2H of batch N-1.
+
+    The comparison metric is the device-side share the pipeline actually
+    targets: dispatch_queue + device p50 (batch-formation and ingest are
+    identical by construction). The pipelined pass also reports the
+    h2d/compute/d2h substage decomposition (serialized predict has no
+    split-phase timings to report)."""
+    import jax
+
+    from storm_tpu.runtime.cluster import LocalCluster
+
+    cfg = CONFIGS[args.config]
+    if "model" not in cfg:
+        sys.exit("--pipeline-compare needs a single-model config")
+    depth = args.pipeline_depth if args.pipeline_depth is not None else 2
+    if depth < 1:
+        sys.exit("--pipeline-depth must be >= 1 for --pipeline-compare")
+    n_dev = len(jax.devices())
+    log(f"devices: {jax.devices()}")
+    buckets = cfg["buckets"]
+    msgs = min(args.messages, 4096)
+    passes = {}
+    cluster = LocalCluster()
+    try:
+        log("== pass 1: serialized engine (pipeline_depth=0) ==")
+        passes["serialized"] = run_latency_pass(
+            cluster, args, cfg, buckets, "bench-pipe-serial",
+            throughput_msgs=msgs, pipeline_depth=0)
+        log(f"== pass 2: pipelined engine (pipeline_depth={depth}) ==")
+        passes["pipelined"] = run_latency_pass(
+            cluster, args, cfg, buckets, "bench-pipe-overlap",
+            throughput_msgs=msgs, pipeline_depth=depth)
+    finally:
+        cluster.shutdown()
+
+    def device_share(p):
+        st = p["stages_p50_ms"]
+        vals = [st.get("dispatch_queue"), st.get("device")]
+        return round(sum(v for v in vals if v is not None), 2)
+
+    ser, pipe = passes["serialized"], passes["pipelined"]
+    ser_ms, pipe_ms = device_share(ser), device_share(pipe)
+    thr_ser = ser.get("records_per_sec")
+    thr_pipe = pipe.get("records_per_sec")
+    return {
+        "metric": f"{cfg['metric']}_pipeline_device_share_p50_ms",
+        "value": pipe_ms,
+        "unit": ("dispatch_queue + device p50 (ms) with the split-phase "
+                 "pipeline, vs the serialized engine in the same run"),
+        "serialized_device_share_p50_ms": ser_ms,
+        "pipelined_device_share_p50_ms": pipe_ms,
+        "speedup": (round(ser_ms / pipe_ms, 3) if pipe_ms else None),
+        "pipelined_below_serialized": bool(pipe_ms < ser_ms),
+        "records_per_sec_serialized": thr_ser,
+        "records_per_sec_pipelined": thr_pipe,
+        "device_substages_p50_ms": {
+            label: pipe["stages_p50_ms"].get(label)
+            for _, label in DEVICE_SUBSTAGES},
+        "pipeline_depth": depth,
+        "latency_valid": bool(ser["valid"] and pipe["valid"]),
+        "serialized": ser,
+        "pipelined": pipe,
+        "chips": n_dev,
+        "config": f"{args.config}+pipeline-compare",
+        "capture_session": _new_capture_session(),
+        "code_version": _code_version(),
     }
 
 
@@ -1861,6 +1962,14 @@ def main() -> None:
                     help="two-pass latency evidence: framework-only "
                          "(NullEngine, device time = 0) percentiles + "
                          "per-stage attribution of the device path")
+    ap.add_argument("--pipeline-compare", action="store_true",
+                    help="split-phase pipeline evidence: serialized engine "
+                         "(pipeline_depth=0) vs pipelined dispatch/fetch in "
+                         "one artifact — dispatch_queue+device p50 and "
+                         "h2d/compute/d2h substages, same code version")
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    help="engine split-phase pipeline depth override "
+                         "(default: BatchConfig default; 0 disables)")
     ap.add_argument("--autoscale", action="store_true",
                     help="closed-loop SLO demo: ramp offered load and let "
                          "the latency-driven autoscaler hold p50 under "
@@ -1919,6 +2028,9 @@ def main() -> None:
         return
     if args.latency_breakdown:
         print(json.dumps(run_latency_breakdown(args)))
+        return
+    if args.pipeline_compare:
+        print(json.dumps(run_pipeline_compare(args)))
         return
     if args.all:
         results = []

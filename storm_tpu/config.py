@@ -42,8 +42,29 @@ class BatchConfig:
     # saturated device -> slots stay busy, batches fill toward max_batch
     # while waiting). The deadline still applies as a fallback bound.
     eager: bool = False
+    # Split-phase device pipeline depth: batches allowed inside the ENGINE
+    # between dispatch (stage -> device_put -> async jit launch) and fetch
+    # (blocking device->host copy on the engine's fetch thread), so the
+    # H2D of batch N+1 overlaps the compute of batch N and the D2H of
+    # batch N-1. 0 disables the pipeline entirely and restores the fully
+    # serialized pad/put/fwd/fetch predict (the pre-pipeline engine).
+    # Distinct from ``max_inflight``, which bounds batches per OPERATOR
+    # task; the ring bounds batches per shared engine across all tasks.
+    pipeline_depth: int = 2
+    # Preallocated host staging buffers per padded bucket shape (the
+    # zero-copy staging pool: one fused write replaces the concat + pad +
+    # cast copies of the stacked path). Each in-flight batch holds one
+    # buffer from dispatch until its fetch completes. 0 = auto
+    # (pipeline_depth + 1, so a dispatch never waits on a recycling fetch).
+    staging_pool: int = 0
 
     def __post_init__(self) -> None:
+        if int(self.pipeline_depth) < 0:
+            raise ValueError(
+                f"batch.pipeline_depth must be >= 0, got {self.pipeline_depth!r}")
+        if int(self.staging_pool) < 0:
+            raise ValueError(
+                f"batch.staging_pool must be >= 0, got {self.staging_pool!r}")
         self.buckets = tuple(sorted(set(int(b) for b in self.buckets)))
         if not self.buckets:
             self.buckets = (self.max_batch,)

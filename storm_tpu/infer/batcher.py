@@ -47,6 +47,12 @@ class Batch:
     def stack(self) -> np.ndarray:
         return np.concatenate([it.data for it in self.items], axis=0)
 
+    def parts(self) -> List[np.ndarray]:
+        """Per-item arrays for the engine's split-phase ``dispatch``: the
+        engine stages them straight into its pooled padded buffer with one
+        fused write, so no concatenated intermediate ever exists."""
+        return [it.data for it in self.items]
+
     def split(self, out: np.ndarray) -> List[Tuple[Any, np.ndarray]]:
         """Slice a (size, K) result back per item."""
         res = []
@@ -92,9 +98,19 @@ class MicroBatcher:
             if flushed is None:
                 return self._take()
             # Rare: both the old batch flushed AND the new record alone
-            # reaches max_batch; keep the new one pending for the deadline
-            # (returning two batches would complicate the caller).
+            # reaches max_batch. ``add`` still returns one batch, but the
+            # new full one must NOT sit until the deadline — the caller
+            # drains it immediately via ``take_ready()``.
         return flushed
+
+    def take_ready(self) -> Optional[Batch]:
+        """Drain a pending batch that already reached max_batch (the
+        two-batches-in-one-add case above). Call in a loop after every
+        ``add`` that returned a batch; returns None when nothing full is
+        parked."""
+        if self._count >= self.cfg.max_batch:
+            return self._take()
+        return None
 
     def take_if_due(self, now: Optional[float] = None) -> Optional[Batch]:
         """Returns the pending batch if the oldest record exceeded the

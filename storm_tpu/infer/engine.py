@@ -16,12 +16,14 @@ from __future__ import annotations
 
 import gc
 import logging
+import queue
 import sys
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from concurrent.futures import Future
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -90,6 +92,113 @@ def dequantize_params(qparams, dtype, keep_dense: bool = False):
         return l["__q"].astype(dtype) * l["__s"].astype(dtype)
 
     return jax.tree_util.tree_map_with_path(deq, qparams, is_leaf=_is_qleaf)
+
+
+# ---- split-phase pipeline plumbing --------------------------------------------
+
+
+class StagingPool:
+    """Preallocated, recycled host staging buffers keyed by (shape, dtype).
+
+    The dispatch phase stages a batch into one of these with a single
+    fused write (replacing the ``np.concatenate`` + pad-``concatenate`` +
+    ``astype`` copies of the stacked path), hands it to ``device_put``,
+    and keeps holding it until the batch's FETCH completes — jax backends
+    may alias a suitably-aligned host buffer instead of copying (CPU
+    zero-copy donation), so recycling before the dependent execution
+    finished could corrupt an in-flight batch. ``limit`` bounds buffers
+    per key; ``acquire`` blocks (on the caller's worker thread) when that
+    many are in flight, which the pipeline ring normally prevents.
+    """
+
+    def __init__(self, limit: int) -> None:
+        self.limit = max(1, int(limit))
+        self.allocated = 0  # fresh np.empty calls ever made (alloc guard)
+        self._lock = threading.Lock()
+        self._free: Dict[tuple, List[np.ndarray]] = {}
+        self._sems: Dict[tuple, threading.Semaphore] = {}
+
+    def acquire(self, shape: tuple, dtype) -> np.ndarray:
+        key = (tuple(shape), np.dtype(dtype))
+        with self._lock:
+            sem = self._sems.get(key)
+            if sem is None:
+                sem = self._sems[key] = threading.Semaphore(self.limit)
+        sem.acquire()
+        with self._lock:
+            free = self._free.setdefault(key, [])
+            if free:
+                return free.pop()
+            self.allocated += 1
+        return np.empty(shape, dtype)
+
+    def release(self, buf: np.ndarray) -> None:
+        key = (buf.shape, np.dtype(buf.dtype))
+        with self._lock:
+            self._free.setdefault(key, []).append(buf)
+            sem = self._sems[key]
+        sem.release()
+
+
+class InflightBatch:
+    """Handle for one batch inside the split-phase pipeline.
+
+    ``future`` resolves (on the engine's fetch thread) to the host
+    ``np.ndarray`` result sliced to the true batch size — or to the
+    exception that failed THIS batch only. ``timings`` carries the
+    per-phase wall-clock attribution once known: ``h2d_ms`` (staging +
+    host->device transfer + async jit launch; includes XLA compile on a
+    cold bucket shape), ``compute_ms`` (launch -> results ready, i.e.
+    device queue + execute) and ``d2h_ms`` (the blocking device->host
+    copy). ``compute_ms``/``d2h_ms`` are filled by the fetch phase, so
+    read them only after ``future`` resolves.
+    """
+
+    __slots__ = ("future", "n", "padded", "timings", "_out", "_buf",
+                 "_t_launched")
+
+    def __init__(self, n: int, padded: int) -> None:
+        self.future: Future = Future()
+        self.n = n
+        self.padded = padded
+        self.timings: Dict[str, float] = {}
+        self._out = None  # device array, dropped after fetch
+        self._buf = None  # staging buffer, recycled after fetch
+        self._t_launched = 0.0
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        return self.future.result(timeout)
+
+
+def _fetch_loop(fetch_q: "queue.SimpleQueue", ring: threading.Semaphore,
+                staging: StagingPool) -> None:
+    """Dedicated fetch thread: completes in-flight batches in dispatch
+    order. Blocking here is the point — one batch's device->host RTT
+    overlaps the NEXT batch's staging/H2D (dispatch holds the lock, fetch
+    never does) and the one-after's device compute. Module-level so the
+    thread never references the engine (see _ensure_fetch_thread); a None
+    sentinel (engine finalizer, tests) shuts it down."""
+    while True:
+        handle = fetch_q.get()
+        if handle is None:
+            return
+        try:
+            handle._out.block_until_ready()
+            t1 = time.perf_counter()
+            res = np.asarray(handle._out)
+            t2 = time.perf_counter()
+            handle.timings["compute_ms"] = (t1 - handle._t_launched) * 1e3
+            handle.timings["d2h_ms"] = (t2 - t1) * 1e3
+            handle._out = None
+            handle.future.set_result(res[:handle.n])
+        except BaseException as e:  # noqa: BLE001 - fail ONLY this batch
+            handle._out = None
+            handle.future.set_exception(e)
+        finally:
+            buf, handle._buf = handle._buf, None
+            if buf is not None:
+                staging.release(buf)
+            ring.release()
 
 
 _COMPILE_CACHE_DIR: Optional[str] = None
@@ -188,6 +297,22 @@ class InferenceEngine:
             d.process_index != jax.process_index()
             for d in self.mesh.devices.flat)
         self._lock = threading.Lock()
+        # Split-phase pipeline state (see dispatch/_fetch_loop). Depth 0 or
+        # multi-process serving (the results fetch is a cross-process
+        # COLLECTIVE that must stay ordered under the dispatch lock)
+        # disable the ring and fall back to the serialized predict.
+        depth = max(0, int(getattr(self.batch_cfg, "pipeline_depth", 2)))
+        self.pipeline_depth = 0 if self._multiprocess else depth
+        pool = int(getattr(self.batch_cfg, "staging_pool", 0)) \
+            or self.pipeline_depth + 1
+        self._staging = StagingPool(pool)
+        self._ring: Optional[threading.Semaphore] = (
+            threading.BoundedSemaphore(self.pipeline_depth)
+            if self.pipeline_depth else None)
+        self._fetch_q: "queue.SimpleQueue[Optional[InflightBatch]]" = \
+            queue.SimpleQueue()
+        self._fetch_thread: Optional[threading.Thread] = None
+        self._fetch_thread_lock = threading.Lock()
 
         params, state = load_or_init(self.model, model_cfg.checkpoint, model_cfg.seed)
         if self.ep > 1:
@@ -390,10 +515,148 @@ class InferenceEngine:
         """Blocking batched forward: pad -> device -> fwd -> host.
 
         Called from a worker thread (asyncio.to_thread) so the event loop
-        keeps batching while the device computes. Thread-safe: jit dispatch
-        is serialized under a lock; XLA executions themselves overlap via
-        the device queue.
+        keeps batching while the device computes. Thread-safe. With the
+        split-phase pipeline enabled this is one ``dispatch`` + wait; with
+        ``pipeline_depth=0`` (or multi-process serving) it is the fully
+        serialized stage/put/fwd/fetch chain.
         """
+        if self._ring is None:
+            return self._predict_serial(x)
+        return self.dispatch((x,)).future.result()
+
+    def dispatch(self, parts: Sequence[np.ndarray]) -> InflightBatch:
+        """Split-phase entry: stage ``parts`` (per-record arrays, already
+        shape-validated) into a pooled staging buffer with one fused
+        write, ship it to the device and launch the jit program
+        asynchronously; the blocking results fetch happens on the
+        engine's dedicated fetch thread in dispatch order. Returns an
+        :class:`InflightBatch` immediately — its future resolves to the
+        host result (or the exception that failed THIS batch only).
+
+        Blocking (bounded): when ``pipeline_depth`` batches are already
+        in flight the call parks on the ring until a fetch completes, so
+        call it from a worker thread, never the event loop. With the
+        pipeline disabled it degrades to the serialized predict wrapped
+        in an already-resolved handle.
+        """
+        n = sum(int(p.shape[0]) for p in parts)
+        handle = InflightBatch(n, self.pad_batch(n))
+        if self._ring is None:
+            x = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            try:
+                handle.future.set_result(self._predict_serial(x))
+            except BaseException as e:  # noqa: BLE001 - fail ONLY this batch
+                handle.future.set_exception(e)
+            return handle
+        self._ensure_fetch_thread()
+        self._ring.acquire()
+        try:
+            self._dispatch_phase(handle, parts)
+        except BaseException as e:  # noqa: BLE001 - fail ONLY this batch
+            buf, handle._buf = handle._buf, None
+            if buf is not None:
+                self._staging.release(buf)
+            self._ring.release()
+            handle.future.set_exception(e)
+            return handle
+        self._fetch_q.put(handle)
+        return handle
+
+    def _stage(self, buf: np.ndarray, parts: Sequence[np.ndarray],
+               n: int) -> None:
+        """The ONE host-side write of the dispatch phase: copy each part
+        into the preallocated padded buffer (casting to the buffer dtype
+        as it lands) and zero the padding rows — fusing what the stacked
+        path did in three full-batch copies (concat, pad-concat, astype)."""
+        ofs = 0
+        for p in parts:
+            k = p.shape[0]
+            buf[ofs:ofs + k] = p
+            ofs += k
+        if ofs < buf.shape[0]:
+            buf[ofs:] = 0
+
+    def _dispatch_phase(self, handle: InflightBatch,
+                        parts: Sequence[np.ndarray]) -> None:
+        t0 = time.perf_counter()
+        padded, n = handle.padded, handle.n
+        cold = padded not in self.compiled_batches
+        if self._quantize:
+            # Stage at full precision first (range must come from the real
+            # rows), then affine-quantize IN PLACE in the f32 buffer and
+            # cast once into the uint8 wire buffer — no temporaries beyond
+            # the two pooled buffers. The f32 buffer never reaches jax, so
+            # it recycles immediately; the uint8 one is held until fetch.
+            f32 = self._staging.acquire((padded, *self.input_shape),
+                                        np.float32)
+            try:
+                self._stage(f32, parts, n)
+                lo = float(f32[:n].min())
+                hi = float(f32[:n].max())
+                scale = np.float32(max((hi - lo) / 255.0, 1e-12))
+                offset = np.float32(lo)
+                buf = self._staging.acquire((padded, *self.input_shape),
+                                            np.uint8)
+                handle._buf = buf
+                np.subtract(f32, offset, out=f32)
+                np.divide(f32, scale, out=f32)
+                np.rint(f32, out=f32)
+                np.clip(f32, 0, 255, out=f32)
+                np.copyto(buf, f32, casting="unsafe")
+            finally:
+                self._staging.release(f32)
+            with self._lock:
+                xd = jax.device_put(buf, self._x_sharding)
+                out = self._fwd_q(self.params, self.state, xd, scale, offset)
+        else:
+            buf = self._staging.acquire((padded, *self.input_shape),
+                                        self.dtype)
+            handle._buf = buf
+            self._stage(buf, parts, n)
+            with self._lock:
+                xd = jax.device_put(buf, self._x_sharding)
+                out = self._fwd(self.params, self.state, xd)
+        t1 = time.perf_counter()
+        self.compiled_batches.add(padded)
+        if cold and self.on_compile is not None:
+            try:
+                self.on_compile(padded, (t1 - t0) * 1e3)
+            except Exception:
+                pass  # an observability hook must never fail a batch
+        handle._out = out
+        handle._t_launched = t1
+        # Staging + H2D + async launch (plus XLA compile when cold — the
+        # on_compile event disambiguates the cliff in a post-mortem).
+        handle.timings["h2d_ms"] = (t1 - t0) * 1e3
+
+    def _ensure_fetch_thread(self) -> None:
+        if self._fetch_thread is not None:
+            return
+        with self._fetch_thread_lock:
+            if self._fetch_thread is None:
+                # The thread must NOT hold the engine (not even via a bound
+                # method): cache eviction (set_engine_cache_limit) detects
+                # orphaned engines by refcount, and a long-lived thread
+                # reference would pin every engine that ever dispatched.
+                # It gets only the queue/ring/pool — none of which hold
+                # params — and a finalizer stops it when the engine dies.
+                t = threading.Thread(
+                    target=_fetch_loop,
+                    args=(self._fetch_q, self._ring, self._staging),
+                    daemon=True,
+                    name=f"storm-tpu-fetch-{self.model_cfg.name}")
+                t.start()
+                self._fetch_thread = t
+                weakref.finalize(self, self._fetch_q.put, None)
+
+    # _fetch_loop is module-level (see _ensure_fetch_thread for why).
+
+    def _predict_serial(self, x: np.ndarray) -> np.ndarray:
+        """The pre-pipeline serialized chain (pad -> cast -> device_put ->
+        fwd -> fetch, one batch at a time). Kept as the ``pipeline_depth=0``
+        escape hatch and as the multi-process path — the cross-process
+        allgather is a collective whose issue order the dispatch lock must
+        cover end to end (see :meth:`_gather_locked`)."""
         n = x.shape[0]
         padded = self.pad_batch(n)
         cold = padded not in self.compiled_batches
@@ -507,7 +770,9 @@ def shared_engine(
         # Batch policy is part of the identity: pad_batch/warmup read the
         # engine's buckets, so two operators with different batching must
         # not share one engine.
-        (batch_cfg.max_batch, tuple(batch_cfg.buckets)) if batch_cfg else None,
+        (batch_cfg.max_batch, tuple(batch_cfg.buckets),
+         getattr(batch_cfg, "pipeline_depth", 2),
+         getattr(batch_cfg, "staging_pool", 0)) if batch_cfg else None,
     )
     with _ENGINES_LOCK:
         if key in _ENGINES:
@@ -578,7 +843,8 @@ class NullEngine:
     <50 ms framework-overhead claim; bench.py --latency-breakdown).
 
     Not a mock of the full InferenceEngine surface — just the protocol the
-    operator uses: ``input_shape``, ``warmup``, ``predict``."""
+    operator uses: ``input_shape``, ``warmup``, ``predict``,
+    ``dispatch``."""
 
     def __init__(self, input_shape: Tuple[int, ...], num_classes: int) -> None:
         self.input_shape = tuple(input_shape)
@@ -591,6 +857,18 @@ class NullEngine:
         n = x.shape[0]
         return np.full((n, self.num_classes), 1.0 / self.num_classes,
                        np.float32)
+
+    def dispatch(self, parts: Sequence[np.ndarray]) -> InflightBatch:
+        # Already-resolved handle with zeroed phase timings: the stage
+        # table then shows the framework path with h2d/compute/d2h ~0,
+        # same as device_ms under predict.
+        n = sum(int(p.shape[0]) for p in parts)
+        handle = InflightBatch(n, n)
+        handle.timings = {"h2d_ms": 0.0, "compute_ms": 0.0, "d2h_ms": 0.0}
+        handle.future.set_result(
+            np.full((n, self.num_classes), 1.0 / self.num_classes,
+                    np.float32))
+        return handle
 
 
 def unload_engine(engine: InferenceEngine) -> bool:

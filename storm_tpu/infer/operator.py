@@ -36,7 +36,7 @@ from storm_tpu.config import BatchConfig, Config, ModelConfig, ShardingConfig
 from storm_tpu.infer.batcher import Batch, MicroBatcher
 from storm_tpu.infer.engine import InferenceEngine, shared_engine
 from storm_tpu.runtime.base import Bolt, OutputCollector, TopologyContext
-from storm_tpu.runtime.tracing import NOT_SAMPLED, span
+from storm_tpu.runtime.tracing import DEVICE_SUBSTAGES, NOT_SAMPLED, span
 from storm_tpu.runtime.tuples import Tuple, Values
 
 
@@ -116,6 +116,17 @@ class InferenceBolt(Bolt):
             self.model_cfg, self.sharding_cfg, self.batch_cfg)
         if self._warmup:
             self._engine.warmup()
+        # The QoS degrade engine compiles here too — its whole purpose is
+        # serving SHED traffic at peak overload, the one moment an XLA
+        # compile on the hot path is least affordable. prepare() then
+        # finds it in the process cache already warm.
+        if self.qos is not None and self.qos.degrade_model:
+            deg = shared_engine(
+                dataclasses.replace(
+                    self.model_cfg, name=self.qos.degrade_model),
+                self.sharding_cfg, self.batch_cfg)
+            if self._warmup:
+                deg.warmup()
         self._prewarmed = True
 
     def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
@@ -154,10 +165,17 @@ class InferenceBolt(Bolt):
         self._m_ingest = m.histogram(cid, "ingest_lag_ms")  # append -> bolt
         self._m_batch_wait = m.histogram(cid, "batch_wait_ms")  # in batcher
         self._m_disp_wait = m.histogram(cid, "dispatch_wait_ms")  # sem queue
+        # Split-phase pipeline substages (engine dispatch/fetch timings):
+        # together they decompose device_ms, so --latency-breakdown keeps
+        # them OUT of the stage sum (device_ms already counts that time).
+        self._m_substage = {
+            key: m.histogram(cid, key) for key, _ in DEVICE_SUBSTAGES}
         # QoS: the shed level is read per tuple, so cache the gauge (the
         # LoadShedController publishes through the same registry); the
         # degrade engine (cheaper model variant for shed traffic) shares
-        # the process-level engine cache and compiles lazily on first use.
+        # the process-level engine cache and is warmed HERE — lazy compile
+        # on the first shed would land the XLA cliff exactly at peak
+        # overload (unless prewarm() already did both off-loop).
         if self.qos is not None:
             self._shed_gauge = m.gauge("qos", "shed_level")
             self._m_shed = m.counter(cid, "shed_rejected")
@@ -167,6 +185,8 @@ class InferenceBolt(Bolt):
                     dataclasses.replace(
                         self.model_cfg, name=self.qos.degrade_model),
                     self.sharding_cfg, self.batch_cfg)
+                if self._warmup and not getattr(self, "_prewarmed", False):
+                    self._degrade_engine.warmup()
             else:
                 self._degrade_engine = None
             # One degrade call in flight at a time: the degrade path is
@@ -280,8 +300,12 @@ class InferenceBolt(Bolt):
             await self._dead_letter(t, payload, str(e))
             return
         batch = self._batcher_add(t, inst.data, t.root_ts or None, lane)
-        if batch is not None:
+        while batch is not None:
             await self._dispatch(batch)
+            # Drain any batch parked at max_batch behind the one just
+            # taken (add returns at most one batch per call; a full one
+            # must not sit until the deadline).
+            batch = self.batcher.take_ready()
         self._kick_flush()
 
     def _batcher_add(self, item, data, ts, lane):
@@ -302,8 +326,9 @@ class InferenceBolt(Bolt):
                 continue
             batch = self._batcher_add(handle, inst.data, t.root_ts or None,
                                       lane)
-            if batch is not None:
+            while batch is not None:
                 await self._dispatch(batch)
+                batch = self.batcher.take_ready()
         self._kick_flush()
 
     async def _dead_letter(self, t: Tuple, payload: str, error: str) -> None:
@@ -391,8 +416,9 @@ class InferenceBolt(Bolt):
             if wait_s > 0:
                 await asyncio.sleep(wait_s)
             batch = self.batcher.take_if_due()
-            if batch is not None:
+            while batch is not None:
                 await self._dispatch(batch)
+                batch = self.batcher.take_ready()
 
     async def _dispatch(self, batch: Batch) -> None:
         # NB: _eager_pending is decremented by a done-callback on the eager
@@ -413,7 +439,8 @@ class InferenceBolt(Bolt):
         self._inflight.add(task)
         task.add_done_callback(self._inflight.discard)
 
-    def _trace_batch(self, batch: Batch, t0: float, t1: float) -> None:
+    def _trace_batch(self, batch: Batch, t0: float, t1: float,
+                     timings=None) -> None:
         """Span bookkeeping for one device round trip: a ``queue_wait``
         span per SAMPLED record (batcher entry -> device start) and ONE
         shared ``device_execute`` span — same span id in every
@@ -435,6 +462,12 @@ class InferenceBolt(Bolt):
         batch_span = tracer.new_span_id()
         links = tuple(qid for _, qid in traced)
         attrs = {"batch_size": batch.size, "records": len(batch.items)}
+        if timings:
+            # Split-phase decomposition of this span's wall time: where the
+            # device round trip went (staging+H2D vs compute vs D2H).
+            for key, _ in DEVICE_SUBSTAGES:
+                if key in timings:
+                    attrs[key] = round(timings[key], 3)
         for ctx, qid in traced:
             tracer.record(ctx, "device_execute", cid, t0, t1,
                           span_id=batch_span, parent_id=qid,
@@ -442,16 +475,35 @@ class InferenceBolt(Bolt):
 
     async def _run_batch(self, batch: Batch) -> None:
         try:
-            x = batch.stack()
+            dispatch = getattr(self.engine, "dispatch", None)
             t0 = time.perf_counter()
-            # Worker thread: the loop keeps batching while the TPU computes.
-            out = await asyncio.to_thread(self.engine.predict, x)
+            timings = None
+            if dispatch is not None:
+                # Split-phase path: dispatch (stage into the engine's
+                # pooled buffer + H2D + async launch) runs on a worker
+                # thread because it can park on the engine's bounded ring;
+                # the result future resolves from the engine's fetch
+                # thread. The dispatch semaphore stays held for the full
+                # round trip, so max_inflight backpressure and deferred
+                # acks keep their pre-pipeline semantics.
+                handle = await asyncio.to_thread(dispatch, batch.parts())
+                out = await asyncio.wrap_future(handle.future)
+                timings = handle.timings
+            else:
+                # Engines without the split-phase surface (degrade path,
+                # custom test doubles): the serialized predict.
+                out = await asyncio.to_thread(self.engine.predict,
+                                              batch.stack())
             t1 = time.perf_counter()
             self._m_device_ms.observe((t1 - t0) * 1e3)
+            if timings:
+                for key, _ in DEVICE_SUBSTAGES:
+                    if key in timings:
+                        self._m_substage[key].observe(timings[key])
             self._m_batch.observe(batch.size)
             self._m_infer.inc(batch.size)
             if self._tracer is not None and self._tracer.active:
-                self._trace_batch(batch, t0, t1)
+                self._trace_batch(batch, t0, t1, timings)
             if self._flight is not None:
                 # Sampled (throttled) batch-formed events: enough to see
                 # batch-size/device-time behavior in a post-mortem without
@@ -506,8 +558,9 @@ class InferenceBolt(Bolt):
 
     async def tick(self) -> None:
         batch = self.batcher.take_if_due()
-        if batch is not None:
+        while batch is not None:
             await self._dispatch(batch)
+            batch = self.batcher.take_ready()
 
     async def flush(self) -> None:
         """Drain: dispatch whatever is pending and wait for in-flight

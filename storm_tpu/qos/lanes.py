@@ -66,6 +66,16 @@ class LaneBatcher:
             return self._take()
         return None
 
+    def take_ready(self) -> Optional[Batch]:
+        """Drain another full batch of leftovers: a take caps at max_batch
+        instances, so heavy multi-instance records can leave >= max_batch
+        still pending after ``add`` returned one batch. The operator loops
+        this after every ready batch so full batches never park until the
+        deadline (same contract as ``MicroBatcher.take_ready``)."""
+        if self._count >= self.cfg.max_batch:
+            return self._take()
+        return None
+
     def take_if_due(self, now: Optional[float] = None) -> Optional[Batch]:
         if not self._heap:
             return None

@@ -36,6 +36,19 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from storm_tpu.runtime.metrics import MetricsRegistry
 
+#: Split-phase pipeline substages of one device round trip, in execution
+#: order: ``(histogram/timing key, stage label)``. Single source of truth —
+#: the engine's InflightBatch.timings keys, the inference operator's
+#: substage histograms, the ``device_execute`` span sub-attrs, and
+#: bench.py's --latency-breakdown stage rows all derive from this tuple.
+#: h2d = staging-buffer write + host->device transfer + async jit launch,
+#: compute = launch -> device ready, d2h = blocking device->host copy.
+DEVICE_SUBSTAGES: Tuple[Tuple[str, str], ...] = (
+    ("h2d_ms", "h2d"),
+    ("compute_ms", "compute"),
+    ("d2h_ms", "d2h"),
+)
+
 
 @contextlib.contextmanager
 def span(metrics: Optional[MetricsRegistry], component: str, name: str) -> Iterator[None]:

@@ -96,8 +96,18 @@ def test_stage_list_matches_operator_histograms():
     from storm_tpu.connectors import sink as sink_mod
     from storm_tpu.infer import operator as op_mod
 
+    from storm_tpu.runtime.tracing import DEVICE_SUBSTAGES
+
     source = inspect.getsource(op_mod) + inspect.getsource(sink_mod)
+    substage_keys = {key for key, _ in DEVICE_SUBSTAGES}
     for comp, hist, _label in bench.STAGES:
+        if hist in substage_keys:
+            # Device substages are recorded by iterating the shared
+            # DEVICE_SUBSTAGES constant (the same one bench derives its
+            # rows from), not by quoted literals.
+            assert "DEVICE_SUBSTAGES" in source, \
+                f"substage {hist} not recorded via DEVICE_SUBSTAGES"
+            continue
         # Histograms are recorded either by their full name or via
         # span(..., "<base>") which appends "_ms" — both as QUOTED string
         # literals; a bare-word match would be satisfied by comments and
