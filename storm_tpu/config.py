@@ -248,10 +248,18 @@ class TopologyConfig:
     spout_chunk: int = 1
     # Tuple-value scheme (Storm StringScheme vs RawScheme,
     # MainTopology.java:100): "string" = decode records to str (compatible
-    # with every component incl. shell/multilang and dist-run's JSON tuple
-    # transport); "raw" = emit broker bytes untouched, skipping a
-    # bytes->str->bytes round trip on the inference hot path.
+    # with every component incl. shell/multilang and the JSON dist wire);
+    # "raw" = emit broker bytes untouched, skipping a bytes->str->bytes
+    # round trip on the inference hot path. Under dist-run, "raw" needs
+    # wire_format="binary" (the default) to cross worker boundaries.
     spout_scheme: str = "string"
+    # Inter-worker tuple wire under dist-run: "binary" = length-prefixed
+    # CRC-protected frames (storm_tpu/dist/wire.py; bytes/ndarray values
+    # cross without re-encoding), with per-peer fallback to JSON for
+    # workers that don't advertise the binary version (mixed-version
+    # clusters); "json" = pin the legacy envelope everywhere — the
+    # compatibility wire for multilang/shell bolts and old receivers.
+    wire_format: str = "binary"
     message_timeout_s: float = 30.0  # at-least-once replay timeout
     inbox_capacity: int = 4096  # bounded executor queues (backpressure)
     tick_interval_s: float = 0.0  # 0 = no tick tuples
@@ -260,6 +268,12 @@ class TopologyConfig:
     # Per-task resource hints for resource-aware dist placement (Storm's
     # RAS): {"component-id": {"memory_mb": N, "cpu": pct}}.
     component_resources: dict = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.wire_format not in ("binary", "json"):
+            raise ValueError(
+                f"unknown wire_format {self.wire_format!r} "
+                "(expected 'binary' or 'json')")
 
 
 @dataclass

@@ -84,11 +84,14 @@ class BrokerSpout(Spout):
         self.chunk = chunk
         # Tuple-value scheme, Storm's StringScheme vs RawScheme
         # (MainTopology.java:100 picks StringScheme): "string" decodes each
-        # record to str (full compat: shell/multilang bolts, dist-run's
-        # JSON tuple transport). "raw" emits the broker bytes untouched —
-        # the JSON decoder parses bytes natively, so the hot path skips a
-        # bytes->str->bytes round trip (~20us/record on a 12KB payload).
-        # Not valid with components that JSON-serialize tuple values.
+        # record to str (full compat: shell/multilang bolts, the JSON dist
+        # wire). "raw" emits the broker bytes untouched — the JSON decoder
+        # parses bytes natively, so the hot path skips a bytes->str->bytes
+        # round trip (~20us/record on a 12KB payload), and under dist-run
+        # the binary wire (TopologyConfig.wire_format="binary", the
+        # default) carries the bytes across workers without re-encoding.
+        # Not valid with components that JSON-serialize tuple values or
+        # with wire_format="json" across worker boundaries.
         if scheme not in ("string", "raw"):
             raise ValueError(f"unknown spout scheme {scheme!r}")
         self.scheme = scheme

@@ -157,19 +157,23 @@ class DistCluster:
         """Ship the recipe to every worker and start it (two-phase).
         Returns the placement used."""
         # Known-statically incompatible: raw-scheme (bytes) tuple values
-        # cannot cross the JSON inter-worker transport. Rejecting here
-        # fails fast; the per-batch TypeError in transport.encode_deliveries
-        # would otherwise be swallowed by the send loop's warn-and-replay,
+        # cannot cross the JSON inter-worker wire. The binary wire (the
+        # default) carries bytes natively, so the check only applies when
+        # the topology pins wire_format="json". Rejecting here fails fast;
+        # the per-batch TypeError in transport.encode_deliveries would
+        # otherwise be swallowed by the send loop's warn-and-replay,
         # livelocking the topology (review r4). Build the recipe locally
         # exactly as each worker will and inspect the REAL spout objects —
         # a config-only check cannot see raw spouts constructed by a
         # custom builder (review r4 follow-up).
-        raw_spouts = _probe_raw_spouts(cfg, builder)
-        if raw_spouts:
-            raise ValueError(
-                f"spout(s) {raw_spouts} use scheme='raw' (bytes tuple "
-                "values), which cannot cross dist-run's JSON tuple "
-                "transport; use scheme='string' for distributed topologies")
+        if getattr(cfg.topology, "wire_format", "binary") == "json":
+            raw_spouts = _probe_raw_spouts(cfg, builder)
+            if raw_spouts:
+                raise ValueError(
+                    f"spout(s) {raw_spouts} use scheme='raw' (bytes tuple "
+                    "values), which cannot cross the JSON inter-worker "
+                    "wire; use scheme='string' or wire_format='binary' "
+                    "for distributed topologies")
         if placement is None:
             placement = self._auto_place(cfg, builder)
         bad = {c: w for c, w in placement.items() if w >= len(self.clients)}

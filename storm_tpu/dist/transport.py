@@ -17,6 +17,15 @@ Envelope notes: ids are 64-bit and JSON numbers lose integer precision past
 *age* (sender_now - root_ts) and is rebased on arrival — e2e latency
 histograms on remote workers stay meaningful (minus network transit, which
 is part of what they should measure anyway).
+
+Two wire formats share these RPCs. The default is the binary frame codec
+in :mod:`storm_tpu.dist.wire` (tagged value slots, raw ``bytes`` allowed,
+CRC-protected, traceparent in the frame header); this module keeps the
+JSON envelope as the negotiated fallback for multilang/shell bolts and
+mixed-version clusters. ``decode_deliveries``/``decode_acks`` below
+auto-detect the format from the first payload byte (JSON arrays start with
+``[`` = 0x5B; binary frames with 0xB7/0xB8), so a receiver accepts either
+regardless of what its own sender half negotiated.
 """
 
 from __future__ import annotations
@@ -27,10 +36,15 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple as Tup
 
 import grpc
 
+from storm_tpu.dist import wire
+from storm_tpu.dist.wire import WIRE_VERSION
 from storm_tpu.runtime.tracing import TraceContext
 from storm_tpu.runtime.tuples import Tuple
 
 SERVICE = "storm_tpu.Dist"
+
+_BIN_DELIVER = bytes((wire.DELIVERY_MAGIC,))
+_BIN_ACK = bytes((wire.ACK_MAGIC,))
 
 #: Shared-secret control-plane auth (VERDICT r4 missing #4): when set, the
 #: controller exports this env var to its workers, every RPC carries the
@@ -105,22 +119,41 @@ def decode_tuple(enc: list, now: float) -> Tuple:
 
 
 def encode_deliveries(deliveries: Iterable[Tup[str, int, Tuple]]) -> bytes:
-    """deliveries: (component_id, task_index, tuple) triples."""
+    """deliveries: (component_id, task_index, tuple) triples (JSON wire).
+
+    ``now`` is sampled once per batch and threaded through; the hot loop
+    pre-sizes the output list and binds the encoder locally rather than
+    re-deriving per-tuple state each iteration.
+    """
     now = time.perf_counter()
+    if not isinstance(deliveries, (list, tuple)):
+        deliveries = list(deliveries)
+    enc = encode_tuple  # local bind: skip the global lookup per tuple
+    out: list = [None] * len(deliveries)
     try:
-        return json.dumps(
-            [[c, i, encode_tuple(t, now)] for c, i, t in deliveries]
-        ).encode("utf-8")
+        for j, (c, i, t) in enumerate(deliveries):
+            out[j] = [c, i, enc(t, now)]
+        return json.dumps(out).encode("utf-8")
     except TypeError as e:
         # The likeliest non-JSON value is a raw-scheme (bytes) payload.
         raise TypeError(
-            "tuple values must be JSON-serializable to cross the "
-            "inter-worker transport; spout scheme='raw' (bytes values) "
-            "requires topology.spout_scheme='string' under dist-run"
+            "tuple values must be JSON-serializable to cross the JSON "
+            "inter-worker wire; spout scheme='raw' (bytes values) needs "
+            "the binary wire (topology.wire_format='binary', the default)"
+            " or topology.spout_scheme='string' under dist-run"
         ) from e
 
 
 def decode_deliveries(payload: bytes) -> List[Tup[str, int, Tuple]]:
+    """Decode a Deliver payload, auto-detecting the wire format.
+
+    Binary frames (magic 0xB7) route to :mod:`storm_tpu.dist.wire`; JSON
+    arrays (leading ``[``) use the envelope above. Receivers therefore
+    accept both formats unconditionally — negotiation only shapes what the
+    sender emits.
+    """
+    if payload[:1] == _BIN_DELIVER:
+        return wire.decode_deliveries(payload, time.perf_counter())
     now = time.perf_counter()
     return [
         (c, i, decode_tuple(enc, now)) for c, i, enc in json.loads(payload)
@@ -128,11 +161,14 @@ def decode_deliveries(payload: bytes) -> List[Tup[str, int, Tuple]]:
 
 
 def encode_acks(ops: Iterable[Tup[str, int, int]]) -> bytes:
-    """ops: ('xor'|'fail', root_id, edge_id) triples."""
+    """ops: ('xor'|'fail', root_id, edge_id) triples (JSON wire)."""
     return json.dumps([[op, str(r), str(e)] for op, r, e in ops]).encode("utf-8")
 
 
 def decode_acks(payload: bytes) -> List[Tup[str, int, int]]:
+    """Decode an Ack payload, auto-detecting binary (0xB8) vs JSON."""
+    if payload[:1] == _BIN_ACK:
+        return wire.decode_acks(payload)
     return [(op, int(r), int(e)) for op, r, e in json.loads(payload)]
 
 

@@ -32,7 +32,7 @@ from typing import Any, Dict, List, Optional, Tuple as Tup
 import grpc
 
 from storm_tpu.config import Config
-from storm_tpu.dist import transport
+from storm_tpu.dist import transport, wire
 from storm_tpu.dist.transport import DistHandler, WorkerClient
 from storm_tpu.runtime.acker import AckLedger
 from storm_tpu.runtime.cluster import TargetGroup, TopologyRuntime
@@ -57,7 +57,7 @@ class PeerSender:
     MAX_BATCH_ITEMS = 512
     RETRIES = 3
 
-    def __init__(self, addr: str) -> None:
+    def __init__(self, addr: str, wire_format: str = "binary") -> None:
         self.client = WorkerClient(addr)
         # Unbounded on purpose: acks must never lose to backpressure (a
         # dropped ack = timeout + replay), and tuple volume is already
@@ -65,6 +65,12 @@ class PeerSender:
         # the blocking Deliver RPC on the receiving side.
         self.queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        # Wire negotiation state: the preference comes from
+        # TopologyConfig.wire_format; whether THIS peer actually takes
+        # binary frames is learned from its ping response ("wire" version)
+        # on first flush and cached. None = not yet negotiated.
+        self._wire_format = wire_format
+        self._use_binary: Optional[bool] = None
 
     def start(self) -> None:
         self._task = asyncio.get_event_loop().create_task(self._loop())
@@ -80,8 +86,11 @@ class PeerSender:
         if item[0] == "a":
             return 48
         t = item[3]
-        return 96 + sum(len(v) if isinstance(v, (str, bytes)) else 16
-                        for v in t.values)
+        return 96 + sum(
+            len(v) if isinstance(v, (str, bytes))
+            else v.nbytes if hasattr(v, "nbytes")  # ndarray (binary wire)
+            else 16
+            for v in t.values)
 
     async def _loop(self) -> None:
         while True:
@@ -102,23 +111,56 @@ class PeerSender:
             acks = [(op, r, e) for kind, op, r, e in
                     (x for x in items if x[0] == "a")]
             try:
+                binary = await self._negotiate()
                 if acks:
-                    await self._send(self.client.ack, transport.encode_acks(acks))
+                    enc_acks = (wire.encode_acks if binary
+                                else transport.encode_acks)
+                    await self._send(self.client.ack, enc_acks(acks))
                 if tuples:
                     # First sampled tuple's context doubles as the RPC-level
                     # traceparent header (per-tuple contexts travel in the
-                    # envelope itself; the header is for gRPC-aware proxies).
+                    # frame/envelope itself; the header is for gRPC-aware
+                    # proxies).
                     tp = next((t.trace.traceparent() for _c, _i, t in tuples
                                if t.trace is not None), None)
+                    enc_tuples = (wire.encode_deliveries if binary
+                                  else transport.encode_deliveries)
                     await self._send(
                         functools.partial(self.client.deliver, traceparent=tp),
-                        transport.encode_deliveries(tuples),
+                        enc_tuples(tuples),
                     )
             except Exception as e:
                 # Exhausted retries: the affected trees hit the ledger
                 # timeout and replay from the spout (at-least-once, same as
                 # a lost Netty transfer in Storm).
                 log.warning("peer %s send failed: %s", self.client.target, e)
+
+    async def _negotiate(self) -> bool:
+        """Decide (once) whether this peer takes binary frames.
+
+        ``wire_format="json"`` pins the fallback without any RPC. For
+        "binary" we read the peer's ping response: a ``wire`` version >= 1
+        means it decodes our frames; its absence means a pre-binary
+        checkout, so this sender drops to the JSON envelope for the
+        connection's lifetime. An unreachable peer leaves the decision
+        uncached and optimistically tries binary — if the peer is down the
+        send fails identically either way and the trees replay; once it
+        answers pings the real answer is cached.
+        """
+        if self._use_binary is not None:
+            return self._use_binary
+        if self._wire_format != "binary":
+            self._use_binary = False
+            return False
+        try:
+            resp = await asyncio.to_thread(self.client.control, "ping", 5.0)
+        except Exception:
+            return True
+        self._use_binary = int(resp.get("wire", 0)) >= 1
+        if not self._use_binary:
+            log.info("peer %s does not advertise the binary wire; "
+                     "falling back to the JSON envelope", self.client.target)
+        return self._use_binary
 
     async def _send(self, fn, payload: bytes) -> None:
         for attempt in range(self.RETRIES):
@@ -262,8 +304,10 @@ class DistRuntime(TopologyRuntime):
         self.worker_idx = worker_idx
         self.placement = placement
         set_worker_tag(worker_idx)
+        self._wire_format = getattr(config.topology, "wire_format", "binary")
         self.senders: Dict[int, PeerSender] = {
-            idx: PeerSender(addr) for idx, addr in peers.items() if idx != worker_idx
+            idx: PeerSender(addr, self._wire_format)
+            for idx, addr in peers.items() if idx != worker_idx
         }
         self.ledger = DistLedger(
             AckLedger(timeout_s=config.topology.message_timeout_s),
@@ -324,7 +368,7 @@ class DistRuntime(TopologyRuntime):
         in flight anyway, and the spout ledger's timeout replays their trees
         (at-least-once, same story as a worker crash under Storm)."""
         old = self.senders.get(idx)
-        sender = PeerSender(addr)
+        sender = PeerSender(addr, self._wire_format)
         self.senders[idx] = sender
         sender.start()
         for spec in self.topology.specs.values():
@@ -426,6 +470,9 @@ class DistRuntime(TopologyRuntime):
 _BUILDERS = {
     "standard": "storm_tpu.main:build_standard_topology",
     "multi": "storm_tpu.main:build_multi_model_topology",
+    # Device-free framework-ceiling topology (NullEngine): what the wire
+    # bench drives so transport cost isn't hidden behind compute.
+    "null": "storm_tpu.main:build_null_engine_topology",
 }
 
 
@@ -499,7 +546,11 @@ class WorkerServer:
     def _control(self, req: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         cmd = req["cmd"]
         if cmd == "ping":
-            return {"ok": True, "index": self.index}
+            # "wire" advertises the binary frame version this worker can
+            # DECODE; peers that see no key treat us as JSON-only (see
+            # PeerSender._negotiate).
+            return {"ok": True, "index": self.index,
+                    "wire": wire.WIRE_VERSION}
         if cmd == "submit":
             cfg = Config.from_dict(req["config"])
             from storm_tpu.main import _make_broker

@@ -76,6 +76,52 @@ def build_standard_topology(cfg: Config, broker):
     return tb.build()
 
 
+def build_null_engine_topology(cfg: Config, broker):
+    """The standard DAG with a :class:`NullEngine` in the inference slot.
+
+    No device work, no XLA compile: predictions are a uniform distribution
+    computed instantly, so everything measured is framework cost — spout
+    decode, routing, ledger, the inter-worker wire. This is the
+    framework-ceiling topology the wire bench (``bench.py --wire-compare``)
+    submits; registered as builder name ``"null"`` so dist workers can
+    rebuild it from the recipe.
+    """
+    from storm_tpu.connectors import BrokerSpout
+    from storm_tpu.infer import InferenceBolt
+    from storm_tpu.infer.engine import NullEngine
+    from storm_tpu.runtime import TopologyBuilder
+
+    qos = cfg.qos if cfg.qos.enabled else None
+    engine = NullEngine(cfg.model.input_shape, cfg.model.num_classes)
+    tb = TopologyBuilder()
+    tb.set_spout(
+        "kafka-spout",
+        BrokerSpout(broker, cfg.broker.input_topic, cfg.offsets,
+                    chunk=cfg.topology.spout_chunk,
+                    scheme=cfg.topology.spout_scheme,
+                    qos=qos),
+        parallelism=cfg.topology.spout_parallelism,
+    )
+    tb.set_bolt(
+        "inference-bolt",
+        InferenceBolt(cfg.model, cfg.batch, cfg.sharding, engine=engine,
+                      warmup=False, qos=qos,
+                      passthrough=("qos_lane",) if qos else ()),
+        parallelism=cfg.topology.inference_parallelism,
+    ).shuffle_grouping("kafka-spout")
+    tb.set_bolt(
+        "kafka-bolt",
+        _make_sink(cfg, broker, cfg.broker.output_topic),
+        parallelism=cfg.topology.sink_parallelism,
+    ).shuffle_grouping("inference-bolt")
+    tb.set_bolt(
+        "dlq-bolt",
+        _make_sink(cfg, broker, cfg.broker.dead_letter_topic),
+        parallelism=1,
+    ).shuffle_grouping("inference-bolt", stream="dead_letter")
+    return tb.build()
+
+
 def build_multi_model_topology(cfg: Config, broker):
     """One spout -> inference -> sink chain per ``cfg.pipelines`` entry, all
     inside a single topology sharing one process and one TPU slice

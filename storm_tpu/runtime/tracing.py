@@ -133,6 +133,24 @@ class TraceContext:
             return None
         return cls(parts[1], parts[2])
 
+    def to_bytes(self) -> Optional[bytes]:
+        """24 raw bytes (16 trace id + 8 span id) for the binary dist wire.
+
+        None on a non-hex context (same garbage-tolerance contract as
+        :meth:`from_traceparent` — the sender drops the trace rather than
+        failing the frame)."""
+        try:
+            return bytes.fromhex(self.trace_id) + bytes.fromhex(self.span_id)
+        except ValueError:
+            return None
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> Optional["TraceContext"]:
+        """Inverse of :meth:`to_bytes`; None on anything but 24 bytes."""
+        if len(raw) != 24:
+            return None
+        return cls(raw[:16].hex(), raw[16:].hex())
+
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return f"TraceContext({self.traceparent()})"
 

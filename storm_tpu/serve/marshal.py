@@ -13,6 +13,13 @@ co-located TPU worker (BASELINE.json north star).
 When the native library is not built, both directions fall back to pyarrow
 (wire-identical — the C++ marshaller is round-trip tested against pyarrow
 in tests/test_native.py).
+
+Besides the serve boundary, these are also the ndarray slot codec of the
+binary dist wire (storm_tpu/dist/wire.py): tensors cross worker
+boundaries as Arrow IPC messages inside CRC-protected frames.
+``decode_tensor`` therefore accepts any buffer object — the dist receiver
+hands it a ``memoryview`` slice of the gRPC payload and the returned
+array stays a zero-copy view over that slice.
 """
 
 from __future__ import annotations
@@ -36,8 +43,11 @@ def encode_tensor(x: np.ndarray) -> bytes:
     return sink.getvalue().to_pybytes()
 
 
-def decode_tensor(buf: bytes) -> np.ndarray:
-    """Arrow IPC tensor bytes -> NumPy view (zero-copy over the buffer)."""
+def decode_tensor(buf) -> np.ndarray:
+    """Arrow IPC tensor bytes -> NumPy view (zero-copy over the buffer).
+
+    ``buf`` may be ``bytes`` or any buffer object (``memoryview``,
+    ``bytearray``); the view keeps it alive via the array's base chain."""
     out = decode_tensor_native(buf)
     if out is not None:
         return out

@@ -62,13 +62,16 @@ def test_ack_envelope_roundtrip():
     assert transport.decode_acks(transport.encode_acks(ops)) == ops
 
 
-def test_raw_scheme_rejected_at_submit():
+def test_raw_scheme_rejected_at_submit_with_json_wire():
     """spout_scheme='raw' (bytes tuple values) is statically incompatible
-    with the JSON tuple transport; submit must fail fast, not livelock in
-    warn-and-replay (the per-batch encode error is swallowed by the send
-    loop)."""
+    with the JSON wire; when a topology PINS wire_format='json' (multilang
+    clusters), submit must fail fast, not livelock in warn-and-replay (the
+    per-batch encode error is swallowed by the send loop). Under the
+    default binary wire the combination is valid and the check is skipped
+    (see test_dist_binary_wire_raw_scheme_matches_local)."""
     cfg = Config()
     cfg.topology.spout_scheme = "raw"
+    cfg.topology.wire_format = "json"
     dc = DistCluster.__new__(DistCluster)  # validation precedes any state
     with pytest.raises(ValueError, match="raw"):
         dc.submit("t", cfg)
@@ -794,3 +797,227 @@ def test_deliver_carries_traceparent_grpc_metadata():
             bad.close()
     finally:
         server.stop(None)
+
+
+# ---- binary wire (storm_tpu/dist/wire.py) ------------------------------------
+
+
+def test_binary_envelope_bytes_roundtrip_via_transport():
+    """Raw-scheme bytes values cross the binary frame and the receiving
+    transport auto-detects the format (the lifted restriction's unit)."""
+    from storm_tpu.dist import wire
+
+    t = Tuple(values=[b"\x00\x01raw-bytes\xff"], fields=("message",),
+              source_component="kafka-spout", source_task=0,
+              stream="default", edge_id=(1 << 56) | 7,
+              anchors=frozenset({(1 << 56) | 3}),
+              root_ts=time.perf_counter() - 0.1,
+              origins=frozenset({("src", 1, 5)}))
+    payload = wire.encode_deliveries([("inference-bolt", 2, t)])
+    assert payload[0] == wire.DELIVERY_MAGIC
+    [(comp, task, back)] = transport.decode_deliveries(payload)
+    assert (comp, task) == ("inference-bolt", 2)
+    assert back.values == [b"\x00\x01raw-bytes\xff"]
+    assert back.anchors == t.anchors and back.origins == t.origins
+    assert abs((time.perf_counter() - back.root_ts) - 0.1) < 0.05
+
+
+def _fake_worker(advertise_wire: bool, received: list):
+    """Minimal Dist service that records Deliver/Ack payload bytes and
+    answers ping with or without the 'wire' version key."""
+    import grpc
+    from concurrent import futures
+
+    from storm_tpu.dist.transport import DistHandler
+    from storm_tpu.dist.wire import WIRE_VERSION
+
+    def deliver_fn(request, context):
+        received.append(("deliver", bytes(request)))
+        return b"{}"
+
+    def ack_fn(request, context):
+        received.append(("ack", bytes(request)))
+        return b"{}"
+
+    def control_fn(request, context):
+        resp = {"ok": True, "index": 0}
+        if advertise_wire:
+            resp["wire"] = WIRE_VERSION
+        return json.dumps(resp).encode()
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers(
+        (DistHandler(deliver_fn, ack_fn, control_fn, token=""),))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    return server, port
+
+
+def _drive_sender(port: int, wire_format: str, received: list,
+                  want_payloads: int = 2, include_bytes: bool = False):
+    """Run a PeerSender against a fake worker, flush one tuple + one ack,
+    and return once the fake saw ``want_payloads`` RPCs.
+
+    ``include_bytes`` adds a ``bytes`` value — only valid when the test
+    expects the binary wire to actually be negotiated (JSON rejects bytes).
+    """
+    import asyncio
+
+    from storm_tpu.dist.worker import PeerSender
+
+    async def drive():
+        s = PeerSender(f"127.0.0.1:{port}", wire_format)
+        s.start()
+        s.put_ack_nowait("xor", (1 << 56) | 5, 17)
+        await s.put_tuple("bolt", 0, Tuple(
+            values=["hello", b"bin"] if include_bytes else ["hello"],
+            fields=("a", "b")[:2 if include_bytes else 1],
+            source_component="s", source_task=0, stream="default",
+            edge_id=3, anchors=frozenset(), root_ts=time.perf_counter()))
+        for _ in range(200):
+            if len(received) >= want_payloads:
+                break
+            await asyncio.sleep(0.025)
+        await s.stop()
+
+    asyncio.run(drive())
+
+
+def test_peer_sender_negotiates_binary_wire():
+    """A peer advertising wire>=1 on ping gets binary frames for both acks
+    and deliveries."""
+    from storm_tpu.dist import wire
+
+    received: list = []
+    server, port = _fake_worker(True, received)
+    try:
+        _drive_sender(port, "binary", received, include_bytes=True)
+    finally:
+        server.stop(None)
+    kinds = dict(received)
+    assert kinds["ack"][0] == wire.ACK_MAGIC
+    assert kinds["deliver"][0] == wire.DELIVERY_MAGIC
+    assert wire.decode_acks(kinds["ack"]) == [("xor", (1 << 56) | 5, 17)]
+
+
+def test_peer_sender_falls_back_to_json_for_old_peer():
+    """A peer whose ping has no 'wire' key (pre-binary checkout) gets the
+    JSON envelope — mixed-version clusters keep flowing."""
+    received: list = []
+    server, port = _fake_worker(False, received)
+    try:
+        _drive_sender(port, "binary", received)
+    finally:
+        server.stop(None)
+    kinds = dict(received)
+    assert kinds["ack"][:1] == b"["
+    assert kinds["deliver"][:1] == b"["
+    assert transport.decode_acks(kinds["ack"]) == [("xor", (1 << 56) | 5, 17)]
+
+
+def test_peer_sender_respects_json_pin():
+    """wire_format='json' pins the envelope even when the peer advertises
+    binary (multilang/shell-bolt clusters)."""
+    received: list = []
+    server, port = _fake_worker(True, received)
+    try:
+        _drive_sender(port, "json", received)
+    finally:
+        server.stop(None)
+    kinds = dict(received)
+    assert kinds["ack"][:1] == b"[" and kinds["deliver"][:1] == b"["
+
+
+@pytest.mark.slow
+def test_dist_binary_wire_raw_scheme_matches_local():
+    """The lifted restriction end-to-end: scheme='raw' + the binary wire
+    under dist-run delivers byte-identical predictions vs the local runner
+    fed the same records (same model seed, same bucket shape)."""
+    from storm_tpu.main import _make_broker, build_standard_topology
+    from storm_tpu.runtime.cluster import LocalCluster
+
+    stub = KafkaStubBroker(partitions=2)
+
+    def make_cfg(prefix):
+        cfg = Config()
+        cfg.broker.kind = "kafka"
+        cfg.broker.bootstrap = f"127.0.0.1:{stub.port}"
+        cfg.broker.input_topic = f"{prefix}-in"
+        cfg.broker.output_topic = f"{prefix}-out"
+        cfg.broker.dead_letter_topic = f"{prefix}-dlq"
+        cfg.model.name = "lenet5"
+        cfg.model.dtype = "float32"
+        cfg.model.input_shape = (28, 28, 1)
+        cfg.offsets.policy = "earliest"
+        cfg.offsets.max_behind = None
+        cfg.batch.max_batch = 8
+        cfg.batch.max_wait_ms = 20
+        # one bucket shape => every device batch pads to 8 rows, so
+        # per-record numerics are independent of how batches formed and
+        # the two runs must agree bit-for-bit
+        cfg.batch.buckets = (8,)
+        cfg.topology.spout_parallelism = 1
+        cfg.topology.inference_parallelism = 2
+        cfg.topology.sink_parallelism = 1
+        cfg.topology.spout_scheme = "raw"  # the formerly-rejected config
+        cfg.topology.message_timeout_s = 60.0
+        return cfg
+
+    n_msgs = 10
+    payloads = []
+    for i in range(n_msgs):
+        x = np.random.RandomState(i).rand(1, 28, 28, 1).astype(np.float32)
+        payloads.append(json.dumps({"instances": x.tolist()}))
+
+    def out_values(topic):
+        with stub._lock:
+            vals = [v for p in range(stub.partitions)
+                    for _k, v, _ts in stub._logs[(topic, p)]]
+        return sorted(vals)
+
+    def pump(producer, topic, out_topic):
+        for p in payloads:
+            producer.produce(topic, p)
+        deadline = time.time() + 120
+        while time.time() < deadline and stub.topic_size(out_topic) < n_msgs:
+            time.sleep(0.1)
+
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+    try:
+        # -- local reference run ------------------------------------------
+        cfg_l = make_cfg("loc")
+        lc = LocalCluster()
+        try:
+            lc.submit_topology("wire-local", cfg_l,
+                               build_standard_topology(cfg_l, _make_broker(cfg_l)))
+            pump(KafkaWireBroker(cfg_l.broker.bootstrap), "loc-in", "loc-out")
+            assert lc.drain("wire-local", timeout_s=30)
+        finally:
+            lc.shutdown()
+        local_out = out_values("loc-out")
+        assert len(local_out) == n_msgs
+
+        # -- distributed run, spout/inference/sink on separate workers ----
+        cfg_d = make_cfg("dst")
+        placement = {"kafka-spout": 0, "inference-bolt": 1,
+                     "kafka-bolt": 2, "dlq-bolt": 2}
+        with DistCluster(3, env={"JAX_PLATFORMS": "cpu",
+                                 "STORM_TPU_PLATFORM": "cpu"}) as cluster:
+            # every worker advertises the binary wire version
+            for c in cluster.clients:
+                assert c.control("ping").get("wire", 0) >= 1
+            cluster.submit("wire-dist", cfg_d, placement)
+            pump(KafkaWireBroker(cfg_d.broker.bootstrap), "dst-in", "dst-out")
+            assert cluster.drain(timeout_s=30)
+            snap = cluster.metrics()
+            assert snap["kafka-spout"].get("tree_failed", 0) == 0, \
+                "replays would make output counts ambiguous"
+            cluster.kill()
+        dist_out = out_values("dst-out")
+
+        assert len(dist_out) == n_msgs
+        assert dist_out == local_out, \
+            "binary wire altered prediction bytes vs the local runner"
+    finally:
+        stub.close()

@@ -261,3 +261,227 @@ def test_record_batch_roundtrip_with_producer_fields(records, pid, epoch, seq):
         (k, v) for k, v in records]
     fields = KafkaStubBroker._batch_producer_fields(data)
     assert fields == (pid, seq, len(records), epoch)
+
+
+# ---- dist wire codecs (binary frames + JSON envelope) ------------------------
+
+
+def _mk_tuple(values, trace=None, origins=frozenset(), anchors=frozenset()):
+    from storm_tpu.runtime.tuples import Tuple
+
+    return Tuple(values=list(values),
+                 fields=tuple(f"f{i}" for i in range(len(values))),
+                 source_component="spout", source_task=2, stream="default",
+                 edge_id=(7 << 56) | 12345, anchors=anchors, root_ts=100.0,
+                 origins=origins, trace=trace)
+
+
+def _values_eq(a, b):
+    """Equality that treats NaN as self-equal and demands type fidelity
+    for the scalar kinds the binary wire tags (bool is not 1)."""
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, float) and isinstance(b, float):
+        return (a != a and b != b) or a == b
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(map(_values_eq, a, b))
+    return type(a) is type(b) and a == b
+
+
+# Surrogates included on purpose (satellite: unicode incl. surrogates):
+# the binary wire must carry lone surrogates via surrogatepass.
+_any_text = st.text(
+    alphabet=st.characters(min_codepoint=0, max_codepoint=0x10FFFF),
+    max_size=48)
+_wire_scalar = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(min_value=-(2**63), max_value=2**63 - 1),
+    st.integers(min_value=2**63, max_value=2**80),  # JSON-slot fallback
+    st.floats(allow_nan=True, allow_infinity=True),
+    _any_text,
+    st.binary(max_size=128),
+)
+_wire_values = st.lists(
+    st.one_of(_wire_scalar, st.lists(_wire_scalar, max_size=4)), max_size=6)
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    batches=st.lists(_wire_values, min_size=0, max_size=5),
+    sampled=st.booleans(),
+    origins=st.lists(st.tuples(st.text(max_size=12),
+                               st.integers(min_value=0, max_value=2**31 - 1),
+                               st.integers(min_value=0, max_value=2**63 - 1)),
+                     max_size=3),
+    anchors=st.lists(st.integers(min_value=0, max_value=2**64 - 1),
+                     max_size=4),
+)
+def test_binary_wire_roundtrip_any_values(batches, sampled, origins, anchors):
+    """Any mix of None/bool/int/bigint/NaN-Inf float/unicode-with-
+    surrogates/bytes/nested-list values survives the binary frame exactly,
+    with type fidelity, along with anchors/origins/trace headers. Covers
+    empty deliveries and empty (zero-arity) tuples."""
+    from storm_tpu.dist import wire
+    from storm_tpu.runtime.tracing import TraceContext
+
+    trace = TraceContext("ab" * 16, "cd" * 8) if sampled else None
+    deliveries = [
+        ("inference-bolt", i % 3,
+         _mk_tuple(vals, trace=trace, origins=frozenset(origins),
+                   anchors=frozenset(anchors)))
+        for i, vals in enumerate(batches)
+    ]
+    frame = wire.encode_deliveries(deliveries, now=200.0)
+    out = wire.decode_deliveries(frame, now=200.0)
+    assert len(out) == len(deliveries)
+    for (c0, i0, t0), (c1, i1, t1) in zip(deliveries, out):
+        assert (c0, i0) == (c1, i1)
+        assert _values_eq(t0.values, t1.values), (t0.values, t1.values)
+        assert t1.fields == t0.fields
+        assert t1.stream == t0.stream
+        assert t1.source_component == t0.source_component
+        assert t1.source_task == t0.source_task
+        assert t1.edge_id == t0.edge_id
+        assert t1.anchors == t0.anchors
+        assert t1.origins == t0.origins
+        assert abs(t1.root_ts - t0.root_ts) < 1e-6
+        if sampled:
+            assert t1.trace.trace_id == "ab" * 16
+            assert t1.trace.span_id == "cd" * 8
+        else:
+            assert t1.trace is None
+
+
+@settings(max_examples=150, deadline=None)
+@given(
+    vals=st.lists(
+        st.one_of(st.none(), st.booleans(), _any_text,
+                  st.integers(min_value=-(2**63), max_value=2**63 - 1),
+                  st.floats(allow_nan=True, allow_infinity=True)),
+        max_size=6),
+)
+def test_json_wire_roundtrip_json_safe_values(vals):
+    """The JSON envelope (the multilang/mixed-version fallback) round-trips
+    every JSON-safe value mix, including NaN/Inf floats, lone-surrogate
+    text, and zero-arity tuples."""
+    from storm_tpu.dist import transport
+
+    deliveries = [("inference-bolt", 1, _mk_tuple(vals))]
+    payload = transport.encode_deliveries(deliveries)
+    out = transport.decode_deliveries(payload)
+    assert len(out) == 1
+    c, i, t = out[0]
+    assert (c, i) == ("inference-bolt", 1)
+    assert _values_eq(t.values, list(vals))
+    assert t.edge_id == (7 << 56) | 12345
+
+
+@settings(max_examples=150, deadline=None)
+@given(
+    ops=st.lists(st.tuples(st.sampled_from(["xor", "anc", "ake", "fail"]),
+                           st.integers(min_value=0, max_value=2**64 - 1),
+                           st.integers(min_value=0, max_value=2**64 - 1)),
+                 max_size=40),
+    use_json=st.booleans(),
+)
+def test_ack_codecs_roundtrip_and_autodetect(ops, use_json):
+    """Both ack codecs round-trip any op/root/edge mix; the receiving
+    decoder auto-detects which one the peer used."""
+    from storm_tpu.dist import transport, wire
+
+    payload = (transport.encode_acks(ops) if use_json
+               else wire.encode_acks(ops))
+    assert transport.decode_acks(payload) == list(ops)
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    vals=_wire_values,
+    flip=st.integers(min_value=0, max_value=2**31 - 1),
+    xor=st.integers(min_value=1, max_value=255),
+)
+def test_binary_wire_corruption_fails_loudly(vals, flip, xor):
+    """Any single-byte corruption of a binary frame raises WireError —
+    never returns garbage deliveries. (Flipping a byte can only go
+    undetected if CRC32 collides, which a single-byte xor cannot cause.)"""
+    import pytest
+
+    from storm_tpu.dist import wire
+
+    frame = bytearray(wire.encode_deliveries(
+        [("b", 0, _mk_tuple(vals))], now=50.0))
+    frame[flip % len(frame)] ^= xor
+    with pytest.raises(wire.WireError):
+        wire.decode_deliveries(bytes(frame), now=50.0)
+
+
+def test_binary_wire_large_values_and_truncation():
+    """>64 KiB str and bytes values cross intact; truncated frames and
+    corrupted ack frames fail loudly; empty frames are valid."""
+    import pytest
+
+    from storm_tpu.dist import wire
+
+    big_bytes = bytes(range(256)) * 400          # 102,400 B
+    big_str = "packet-é" * 9000             # > 64 KiB utf-8
+    t = _mk_tuple([big_bytes, big_str])
+    frame = wire.encode_deliveries([("b", 3, t)], now=1.0)
+    out = wire.decode_deliveries(frame, now=1.0)
+    assert out[0][2].values[0] == big_bytes
+    assert out[0][2].values[1] == big_str
+
+    for cut in (0, 3, 11, len(frame) // 2, len(frame) - 1):
+        with pytest.raises(wire.WireError):
+            wire.decode_deliveries(frame[:cut], now=1.0)
+
+    acks = wire.encode_acks([("xor", 1, 2)])
+    bad = bytearray(acks)
+    bad[9] ^= 0x40
+    with pytest.raises(wire.WireError):
+        wire.decode_acks(bytes(bad))
+    with pytest.raises(wire.WireError):
+        wire.decode_acks(acks[:-2])
+
+    assert wire.decode_deliveries(
+        wire.encode_deliveries([], now=0.0), now=0.0) == []
+    assert wire.decode_acks(wire.encode_acks([])) == []
+
+
+def test_binary_wire_ndarray_slot_roundtrip():
+    """ndarray values ride the Arrow IPC marshaller inside the frame and
+    come back dtype/shape/byte-identical (zero-copy view on decode)."""
+    import pytest
+
+    from storm_tpu.dist import wire
+
+    try:
+        from storm_tpu.serve.marshal import decode_tensor, encode_tensor
+        encode_tensor(np.zeros((1,), np.float32))
+    except ImportError:
+        pytest.skip("no tensor marshaller available (native or pyarrow)")
+
+    arr = np.arange(2 * 28 * 28, dtype=np.float32).reshape(2, 28, 28)
+    frame = wire.encode_deliveries([("b", 0, _mk_tuple([arr]))], now=0.0)
+    got = wire.decode_deliveries(frame, now=0.0)[0][2].values[0]
+    assert isinstance(got, np.ndarray)
+    assert got.dtype == arr.dtype and got.shape == arr.shape
+    assert np.array_equal(got, arr)
+
+
+def test_binary_wire_rejects_newer_version_and_bad_magic():
+    """A frame stamped with a future version or an unknown magic byte is
+    rejected before any payload parsing (negotiation must prevent this;
+    the decoder is the backstop)."""
+    import pytest
+
+    from storm_tpu.dist import wire
+
+    frame = bytearray(wire.encode_deliveries([], now=0.0))
+    frame[1] = wire.WIRE_VERSION + 1
+    with pytest.raises(wire.WireError, match="version"):
+        wire.decode_deliveries(bytes(frame), now=0.0)
+    frame = bytearray(wire.encode_deliveries([], now=0.0))
+    frame[0] = 0x7B  # '{' — not a JSON array either
+    with pytest.raises(wire.WireError, match="magic"):
+        wire.decode_deliveries(bytes(frame), now=0.0)
