@@ -1,0 +1,22 @@
+"""Confidence-gated model cascade (ISSUE 5 tentpole).
+
+``policy`` holds :class:`CascadeConfig` + the uncertainty math (import-light:
+``Config`` embeds it); ``router`` holds the runtime that drives one shared
+engine per tier through the operator's dispatch/fetch pipeline. The router
+is exposed lazily so importing ``storm_tpu.config`` never drags the engine
+stack in.
+"""
+
+from storm_tpu.cascade.policy import (  # noqa: F401
+    CONFIDENCE_METRICS, CascadeConfig, fit_temperature, uncertainty)
+
+__all__ = ["CONFIDENCE_METRICS", "CascadeConfig", "CascadeRouter",
+           "Escalated", "fit_temperature", "uncertainty"]
+
+
+def __getattr__(name):
+    if name in ("CascadeRouter", "Escalated"):
+        from storm_tpu.cascade import router
+
+        return getattr(router, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

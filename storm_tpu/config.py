@@ -15,6 +15,8 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Optional
 
+from storm_tpu.cascade.policy import CascadeConfig
+
 
 @dataclass
 class BatchConfig:
@@ -620,6 +622,10 @@ class Config:
     control: ControlConfig = field(default_factory=ControlConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     qos: QosConfig = field(default_factory=QosConfig)
+    # Confidence-gated model cascade (storm_tpu/cascade/): tiered serving
+    # where easy records accept at a cheap tier and only the hard residue
+    # escalates to the flagship. TOML: [cascade].
+    cascade: CascadeConfig = field(default_factory=CascadeConfig)
     # Multi-model topology: non-empty => ``run`` builds one spout->infer->sink
     # chain per entry instead of the single-model DAG. TOML: [[pipelines]].
     pipelines: list = field(default_factory=list)

@@ -989,6 +989,9 @@ def engine_inventory() -> dict:
     rows = [
         {
             "model": e.model_cfg.name,
+            # Distinguishes cascade tiers / swap variants that share a
+            # registry name but serve different weights.
+            "checkpoint": getattr(e.model_cfg, "checkpoint", None) or None,
             "weights": getattr(e.model_cfg, "weights", "float"),
             "dtype": str(e.dtype),
             "param_bytes": e.param_bytes(),

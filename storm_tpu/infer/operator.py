@@ -24,14 +24,13 @@ replay (the reference swallowed inference errors)."""
 from __future__ import annotations
 
 import asyncio
-import dataclasses
 import time
 from typing import Optional, Sequence, Set
 
-import numpy as np
-
 from storm_tpu.api.schema import (
     DeadLetter, Overloaded, SchemaError, decode_instances, encode_predictions)
+from storm_tpu.cascade.policy import CascadeConfig
+from storm_tpu.cascade.router import CascadeRouter, Escalated
 from storm_tpu.config import BatchConfig, Config, ModelConfig, ShardingConfig
 from storm_tpu.infer.batcher import Batch, MicroBatcher
 from storm_tpu.infer.engine import InferenceEngine, shared_engine
@@ -71,6 +70,7 @@ class InferenceBolt(Bolt):
         warmup: bool = True,
         passthrough: Sequence[str] = (),
         qos=None,
+        cascade: Optional[CascadeConfig] = None,
     ) -> None:
         self.model_cfg = model or ModelConfig()
         self.batch_cfg = batch or BatchConfig()
@@ -86,11 +86,16 @@ class InferenceBolt(Bolt):
         # shed-eligible tuples are degraded/rejected while the shed level
         # (gauge ("qos", "shed_level")) is raised.
         self.qos = qos if (qos is not None and qos.enabled) else None
+        # CascadeConfig (cascade/policy.py) or None: confidence-gated
+        # tiered serving — records enter at tier 0 and only the
+        # low-confidence residue escalates toward the flagship.
+        self.cascade = cascade if (cascade is not None
+                                   and cascade.enabled) else None
 
     def clone(self) -> "InferenceBolt":
         return InferenceBolt(
             self.model_cfg, self.batch_cfg, self.sharding_cfg, self._engine,
-            self._warmup, self.passthrough, self.qos
+            self._warmup, self.passthrough, self.qos, self.cascade
         )
 
     def declare_output_fields(self):
@@ -116,18 +121,36 @@ class InferenceBolt(Bolt):
             self.model_cfg, self.sharding_cfg, self.batch_cfg)
         if self._warmup:
             self._engine.warmup()
-        # The QoS degrade engine compiles here too — its whole purpose is
-        # serving SHED traffic at peak overload, the one moment an XLA
-        # compile on the hot path is least affordable. prepare() then
-        # finds it in the process cache already warm.
-        if self.qos is not None and self.qos.degrade_model:
-            deg = shared_engine(
-                dataclasses.replace(
-                    self.model_cfg, name=self.qos.degrade_model),
-                self.sharding_cfg, self.batch_cfg)
-            if self._warmup:
-                deg.warmup()
+        # Cascade tiers compile here too (the QoS degrade tier included —
+        # its whole purpose is serving SHED traffic at peak overload, the
+        # one moment an XLA compile on the hot path is least affordable).
+        # prepare() then finds them in the process cache already warm.
+        cas = self._cascade_cfg()
+        if cas is not None:
+            probe = CascadeRouter(cas, qos=self.qos)
+            for i in range(len(cas.tiers)):
+                mc = probe.tier_model(i, self.model_cfg)
+                if mc is self.model_cfg:
+                    continue  # the flagship engine, warmed above
+                eng = shared_engine(mc, self.sharding_cfg, self.batch_cfg)
+                if self._warmup:
+                    eng.warmup()
         self._prewarmed = True
+
+    def _cascade_cfg(self) -> Optional[CascadeConfig]:
+        """The effective cascade: the explicit config when given, else a
+        synthesized two-tier shed-only cascade for ``qos.degrade_model``
+        (the old cheaper-model-behind-a-semaphore degrade path, now just
+        a cascade whose tier 0 serves pinned shed traffic with normal
+        batching and ``max_inflight`` concurrency)."""
+        if self.cascade is not None:
+            return self.cascade
+        if self.qos is not None and self.qos.degrade_model:
+            return CascadeConfig(
+                enabled=True,
+                tiers=(self.qos.degrade_model, self.model_cfg.name),
+                thresholds=(0.0,), shed_only=True)
+        return None
 
     def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
         super().prepare(context, collector)
@@ -136,14 +159,40 @@ class InferenceBolt(Bolt):
         self.engine = self._engine or shared_engine(
             self.model_cfg, self.sharding_cfg, self.batch_cfg
         )
-        if self._warmup and not getattr(self, "_prewarmed", False):
+        prewarmed = getattr(self, "_prewarmed", False)
+        if self._warmup and not prewarmed:
             self.engine.warmup()
+        # Cascade (explicit config, or synthesized from qos.degrade_model):
+        # one shared engine + residue batcher per tier. The operator keeps
+        # owning tasks, acks, and the dispatch semaphore — max_inflight now
+        # bounds device round trips ACROSS tiers.
+        cas = self._cascade_cfg()
+        if cas is not None:
+            self._router = CascadeRouter(cas, qos=self.qos)
+            self._router.build(
+                self.model_cfg, self.sharding_cfg, self.batch_cfg,
+                build_engine=lambda mc: shared_engine(
+                    mc, self.sharding_cfg, self.batch_cfg),
+                flagship=self.engine,
+                warmup=self._warmup and not prewarmed)
+        else:
+            self._router = None
         if self.qos is not None:
             from storm_tpu.qos.lanes import LaneBatcher
 
             self.batcher = LaneBatcher(self.batch_cfg, self.qos)
         else:
             self.batcher = MicroBatcher(self.batch_cfg)
+        if self._router is not None:
+            # Cascade ingest goes through the tier batchers; self.batcher
+            # stays as an alias of the default entry tier's batcher so
+            # introspection (len, tests) keeps working.
+            entry0 = cas.last_tier if cas.shed_only else 0
+            self.batcher = self._router.tiers[entry0].batcher
+            self._sources = [
+                (t.index, t.batcher) for t in self._router.tiers]
+        else:
+            self._sources = [(None, self.batcher)]
         self._flush_task: Optional[asyncio.Task] = None
         self._inflight: Set[asyncio.Task] = set()
         self._dispatch_sem = asyncio.Semaphore(
@@ -170,52 +219,53 @@ class InferenceBolt(Bolt):
         # them OUT of the stage sum (device_ms already counts that time).
         self._m_substage = {
             key: m.histogram(cid, key) for key, _ in DEVICE_SUBSTAGES}
+        if self._router is not None:
+            self._router.bind_metrics(m, cid)
         # QoS: the shed level is read per tuple, so cache the gauge (the
-        # LoadShedController publishes through the same registry); the
-        # degrade engine (cheaper model variant for shed traffic) shares
-        # the process-level engine cache and is warmed HERE — lazy compile
-        # on the first shed would land the XLA cliff exactly at peak
-        # overload (unless prewarm() already did both off-loop).
+        # LoadShedController publishes through the same registry). The
+        # degrade path now lives in the cascade: qos.degrade_model
+        # synthesizes a shed-only cascade whose tier 0 serves pinned shed
+        # traffic — batched, under the normal max_inflight concurrency —
+        # replacing the old unbatched single-slot degrade semaphore.
         if self.qos is not None:
             self._shed_gauge = m.gauge("qos", "shed_level")
             self._m_shed = m.counter(cid, "shed_rejected")
             self._m_degraded = m.counter(cid, "shed_degraded")
-            if self.qos.degrade_model:
-                self._degrade_engine = shared_engine(
-                    dataclasses.replace(
-                        self.model_cfg, name=self.qos.degrade_model),
-                    self.sharding_cfg, self.batch_cfg)
-                if self._warmup and not getattr(self, "_prewarmed", False):
-                    self._degrade_engine.warmup()
-            else:
-                self._degrade_engine = None
-            # One degrade call in flight at a time: the degrade path is
-            # unbatched (per shed tuple), so it must not be able to starve
-            # the primary engine's thread pool under overload — when the
-            # slot is busy, shed traffic falls back to typed rejection.
-            self._degrade_sem = asyncio.Semaphore(1)
         # Distributed tracing + flight recorder (runtime/tracing.py).
         self._tracer = getattr(context, "tracer", None)
         self._flight = getattr(context, "flight", None)
         if self._flight is not None:
             # Cold XLA compiles ride the hot path (a new bucket shape) —
             # exactly the latency cliff a post-mortem needs to see.
-            self.engine.on_compile = (
+            hook = (
                 lambda shape, ms, cid=cid, fl=self._flight: fl.event(
                     "xla_compile", component=cid, batch_shape=shape,
                     compile_ms=round(ms, 1)))
+            self.engine.on_compile = hook
+            if self._router is not None:
+                for rt in self._router.tiers:
+                    try:
+                        rt.engine.on_compile = hook
+                    except AttributeError:
+                        pass  # slotted test double
 
     # ---- ingest --------------------------------------------------------------
 
-    # Batch items are either a raw Tuple (one record per tuple) or a
-    # _ChunkHandle (chunked ingestion). These two helpers are the only
-    # places that distinguish them.
+    # Batch items are a raw Tuple (one record per tuple), a _ChunkHandle
+    # (chunked ingestion), or either wrapped in Escalated while riding a
+    # cascade escalation tier. These two helpers are the only places that
+    # distinguish them — completion always unwraps to the ORIGINAL tuple,
+    # so deferred acks and replay are tier-blind (exactly-once preserved).
 
     @staticmethod
     def _anchor_of(item) -> Tuple:
+        if isinstance(item, Escalated):
+            item = item.payload
         return item.tuple if isinstance(item, _ChunkHandle) else item
 
     def _complete(self, item, ok: bool) -> None:
+        if isinstance(item, Escalated):
+            item = item.payload
         if isinstance(item, _ChunkHandle):
             item.done(ok, self.collector)
         elif ok:
@@ -246,22 +296,37 @@ class InferenceBolt(Bolt):
             stream="dead_letter", anchors=[anchor],
         )
 
+    def __getattr__(self, name):
+        # `_sources` is assigned in prepare(); bolts built without it
+        # (partial skeletons in tests, subclasses overriding prepare)
+        # see their plain `batcher` as the only drain source.
+        if name == "_sources":
+            return [(None, self.batcher)]
+        raise AttributeError(name)
+
+    def _pending(self) -> int:
+        return sum(len(b) for _, b in self._sources)
+
     def _kick_flush(self) -> None:
         try:
             asyncio.get_running_loop()
         except RuntimeError:
             return  # loop torn down mid-finalizer (cluster shutdown race)
-        if self._eager and len(self.batcher) and \
+        if self._eager and self._pending() and \
                 not self._dispatch_sem.locked() and not self._eager_pending:
             # Work-conserving: a device slot is free and records are
             # waiting — dispatch now rather than age toward the deadline.
             # Under load every slot is busy, this branch never fires, and
             # batches fill toward max_batch while they queue.
-            batch = self.batcher.take_all()
+            batch, tier = None, None
+            for tier, b in self._sources:
+                batch = b.take_all()
+                if batch is not None:
+                    break
             if batch is not None:
                 self._eager_pending += 1
                 task = asyncio.get_running_loop().create_task(
-                    self._dispatch(batch))
+                    self._dispatch(batch, tier))
                 self._inflight.add(task)
                 task.add_done_callback(self._inflight.discard)
                 # Decrement when the task finishes — however it finishes.
@@ -272,7 +337,7 @@ class InferenceBolt(Bolt):
                     lambda _t: setattr(
                         self, "_eager_pending", self._eager_pending - 1))
                 return
-        if len(self.batcher) and (self._flush_task is None or self._flush_task.done()):
+        if self._pending() and (self._flush_task is None or self._flush_task.done()):
             self._flush_task = asyncio.get_running_loop().create_task(
                 self._deadline_flush()
             )
@@ -284,36 +349,56 @@ class InferenceBolt(Bolt):
             self._m_ingest.observe((time.perf_counter() - t.root_ts) * 1e3)
         payload = t.get("message")
         lane = t.get("qos_lane", None) if self.qos is not None else None
-        if self.qos is not None:
-            level = int(self._shed_gauge.value)
-            if level > 0 and self.qos.shed_eligible(lane, level):
-                # Shed BEFORE decode: the whole point is spending nothing
-                # on traffic we will not serve at full fidelity.
+        level = int(self._shed_gauge.value) if self.qos is not None else 0
+        if level > 0 and self.qos.shed_eligible(lane, level):
+            if self._router is None:
+                # Shed BEFORE decode: with no cascade to degrade onto, the
+                # whole point is spending nothing on traffic we will not
+                # serve at full fidelity.
                 await self._shed_tuple(t, payload, lane, level)
                 return
+            # Cascade degrade: the record serves at tier 0 — pinned there
+            # by decide(), batched, under normal max_inflight concurrency —
+            # so fall through to the regular ingest path.
+            n = len(payload) if isinstance(payload, (list, tuple)) else 1
+            self._m_degraded.inc(n)
+            if self._flight is not None:
+                self._flight.event(
+                    "shed_degrade", throttle_s=1.0,
+                    component=self.context.component_id,
+                    lane=lane, level=level, records=n)
+        entry = (self._router.entry_tier(lane, level)
+                 if self._router is not None else None)
         if isinstance(payload, (list, tuple)):
-            await self._execute_chunk(t, payload, lane)
+            await self._execute_chunk(t, payload, lane, entry)
             return
         try:
             inst = self._decode_checked(payload, t.root_ts)
         except SchemaError as e:
             await self._dead_letter(t, payload, str(e))
             return
-        batch = self._batcher_add(t, inst.data, t.root_ts or None, lane)
-        while batch is not None:
-            await self._dispatch(batch)
-            # Drain any batch parked at max_batch behind the one just
-            # taken (add returns at most one batch per call; a full one
-            # must not sit until the deadline).
-            batch = self.batcher.take_ready()
+        await self._ingest(t, inst.data, t.root_ts or None, lane, entry)
         self._kick_flush()
 
-    def _batcher_add(self, item, data, ts, lane):
+    async def _ingest(self, item, data, ts, lane, entry) -> None:
+        """Add one record to its entry batcher (a cascade tier's when a
+        router is active, the plain operator batcher otherwise) and drain
+        every batch that comes due — add returns at most one batch per
+        call; a full one must not sit until the deadline."""
+        if entry is None:
+            b, tier = self.batcher, None
+        else:
+            b, tier = self._router.tiers[entry].batcher, entry
         if self.qos is not None:
-            return self.batcher.add(item, data, ts=ts, lane=lane)
-        return self.batcher.add(item, data, ts=ts)
+            batch = b.add(item, data, ts=ts, lane=lane)
+        else:
+            batch = b.add(item, data, ts=ts)
+        while batch is not None:
+            await self._dispatch(batch, tier)
+            batch = b.take_ready()
 
-    async def _execute_chunk(self, t: Tuple, payloads, lane=None) -> None:
+    async def _execute_chunk(self, t: Tuple, payloads, lane=None,
+                             entry=None) -> None:
         handle = _ChunkHandle(t, len(payloads))
         for payload in payloads:
             try:
@@ -324,11 +409,8 @@ class InferenceBolt(Bolt):
                 await self._emit_dead_letter(t, payload, str(e))
                 handle.done(True, self.collector)
                 continue
-            batch = self._batcher_add(handle, inst.data, t.root_ts or None,
-                                      lane)
-            while batch is not None:
-                await self._dispatch(batch)
-                batch = self.batcher.take_ready()
+            await self._ingest(handle, inst.data, t.root_ts or None, lane,
+                               entry)
         self._kick_flush()
 
     async def _dead_letter(self, t: Tuple, payload: str, error: str) -> None:
@@ -341,26 +423,22 @@ class InferenceBolt(Bolt):
     # ---- QoS shedding --------------------------------------------------------
 
     async def _shed_tuple(self, t: Tuple, payload, lane, level: int) -> None:
-        """Graceful degradation for a shed-eligible tuple while the shed
-        level is raised: serve it on the cheaper degrade engine when one is
-        configured and free, otherwise answer immediately with a typed
-        ``Overloaded`` record — either way the client gets a parseable
-        response *now* instead of a timeout, and the tuple acks (shedding
-        must never trigger replay: replaying rejected load is more load)."""
+        """Typed rejection for a shed-eligible tuple while the shed level
+        is raised and no cascade exists: answer immediately with an
+        ``Overloaded`` record — the client gets a parseable response *now*
+        instead of a timeout, and the tuple acks (shedding must never
+        trigger replay: replaying rejected load is more load). Graceful
+        degradation lives in the cascade: a configured ``qos.degrade_model``
+        pins shed traffic to cascade tier 0, so this path is reject-only."""
         payloads = payload if isinstance(payload, (list, tuple)) else [payload]
-        degraded = False
-        if self._degrade_engine is not None and not self._degrade_sem.locked():
-            degraded = await self._degrade(t, payloads)
-        if not degraded:
-            msg = Overloaded(lane=lane or "", shed_level=level).to_json()
-            for _ in payloads:
-                await self.collector.emit(
-                    Values([msg, *self._extras(t)]), anchors=[t])
-            self._m_shed.inc(len(payloads))
-        action = "degrade" if degraded else "reject"
+        msg = Overloaded(lane=lane or "", shed_level=level).to_json()
+        for _ in payloads:
+            await self.collector.emit(
+                Values([msg, *self._extras(t)]), anchors=[t])
+        self._m_shed.inc(len(payloads))
         if self._flight is not None:
             self._flight.event(
-                "shed_" + action, throttle_s=1.0,
+                "shed_reject", throttle_s=1.0,
                 component=self.context.component_id,
                 lane=lane, level=level, records=len(payloads))
         ctx = t.trace
@@ -370,37 +448,9 @@ class InferenceBolt(Bolt):
             self._tracer.record(
                 ctx, "qos_shed", self.context.component_id,
                 t.root_ts or now, now,
-                attrs={"lane": lane or "", "level": level, "action": action})
+                attrs={"lane": lane or "", "level": level,
+                       "action": "reject"})
         self.collector.ack(t)
-
-    async def _degrade(self, t: Tuple, payloads) -> bool:
-        """Run shed traffic on the cheaper model variant, unbatched (one
-        predict per shed tuple, single slot — see the semaphore note in
-        prepare). Returns False (caller rejects instead) on any decode or
-        shape mismatch: the degrade path must stay cheap and infallible."""
-        eng = self._degrade_engine
-        try:
-            arrs = [decode_instances(p, ts=t.root_ts).data for p in payloads]
-        except SchemaError:
-            return False
-        x = np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
-        if tuple(x.shape[1:]) != eng.input_shape:
-            return False
-        async with self._degrade_sem:
-            try:
-                out = await asyncio.to_thread(eng.predict, x)
-            except Exception as e:
-                self.collector.report_error(e)
-                return False
-        i = 0
-        for arr in arrs:
-            n = arr.shape[0]
-            msg = encode_predictions(out[i:i + n])
-            i += n
-            await self.collector.emit(
-                Values([msg, *self._extras(t)]), anchors=[t])
-        self._m_degraded.inc(len(payloads))
-        return True
 
     # ---- batching / dispatch -------------------------------------------------
 
@@ -409,18 +459,31 @@ class InferenceBolt(Bolt):
         cancel between take and dispatch would silently drop the batch), it
         just exits when the batcher drains."""
         while True:
-            oldest = self.batcher.oldest_ts
+            oldest = min(
+                (b.oldest_ts for _, b in self._sources
+                 if b.oldest_ts is not None), default=None)
             if oldest is None:
                 return
             wait_s = self.batch_cfg.max_wait_ms / 1e3 - (time.perf_counter() - oldest)
             if wait_s > 0:
                 await asyncio.sleep(wait_s)
-            batch = self.batcher.take_if_due()
-            while batch is not None:
-                await self._dispatch(batch)
-                batch = self.batcher.take_ready()
+            for tier, b in self._sources:
+                batch = b.take_if_due()
+                while batch is not None:
+                    await self._dispatch(batch, tier)
+                    batch = b.take_ready()
 
-    async def _dispatch(self, batch: Batch) -> None:
+    def _spawn_dispatch(self, batch: Batch, tier: Optional[int]) -> None:
+        """Dispatch on a fresh task — for callers that must NOT await the
+        dispatch semaphore (``_escalate`` runs under ``_run_batch``, which
+        still HOLDS a semaphore slot: awaiting _dispatch there deadlocks
+        at max_inflight=1)."""
+        task = asyncio.get_running_loop().create_task(
+            self._dispatch(batch, tier))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    async def _dispatch(self, batch: Batch, tier: Optional[int] = None) -> None:
         # NB: _eager_pending is decremented by a done-callback on the eager
         # task (see _kick_flush), NOT here — a cancel while parked on the
         # semaphore (or before the first step) must still restore it.
@@ -435,33 +498,45 @@ class InferenceBolt(Bolt):
         await self._dispatch_sem.acquire()
         # Stage: wait for a free device slot (max_inflight backpressure).
         self._m_disp_wait.observe((time.perf_counter() - t0) * 1e3)
-        task = asyncio.get_running_loop().create_task(self._run_batch(batch))
+        task = asyncio.get_running_loop().create_task(
+            self._run_batch(batch, tier))
         self._inflight.add(task)
         task.add_done_callback(self._inflight.discard)
 
     def _trace_batch(self, batch: Batch, t0: float, t1: float,
-                     timings=None) -> None:
+                     timings=None, tier: Optional[int] = None):
         """Span bookkeeping for one device round trip: a ``queue_wait``
         span per SAMPLED record (batcher entry -> device start) and ONE
-        shared ``device_execute`` span — same span id in every
+        shared device span — ``device_execute``, or ``cascade_tier{i}``
+        when a cascade tier served the batch — same span id in every
         participating trace, linked to all member record spans — so the
         fan-in of N records into one batch is first-class in the trace
-        (and queue-wait vs. device time separable per record). Only
-        called when the tracer is active; per-record work only for
-        sampled records."""
+        (and queue-wait vs. device time separable per record). Escalated
+        records' queue_wait spans link back to the span of the tier that
+        escalated them, chaining a hard record's tier-to-tier journey.
+        Only called when the tracer is active; per-record work only for
+        sampled records. Returns the shared span's id (None when no
+        member record is sampled) for escalation links."""
         tracer = self._tracer
         cid = self.context.component_id
         traced = []
         for it in batch.items:
             ctx = self._anchor_of(it.payload).trace
             if ctx is not None:
+                links = ()
+                if isinstance(it.payload, Escalated) and it.payload.link_span:
+                    links = (it.payload.link_span,)
                 traced.append((ctx, tracer.record(
-                    ctx, "queue_wait", cid, it.enq or t0, t0)))
+                    ctx, "queue_wait", cid, it.enq or t0, t0, links=links)))
         if not traced:
-            return
+            return None
         batch_span = tracer.new_span_id()
         links = tuple(qid for _, qid in traced)
+        name = "device_execute" if tier is None else f"cascade_tier{tier}"
         attrs = {"batch_size": batch.size, "records": len(batch.items)}
+        if tier is not None:
+            attrs["tier"] = tier
+            attrs["model"] = self._router.tiers[tier].name
         if timings:
             # Split-phase decomposition of this span's wall time: where the
             # device round trip went (staging+H2D vs compute vs D2H).
@@ -469,13 +544,17 @@ class InferenceBolt(Bolt):
                 if key in timings:
                     attrs[key] = round(timings[key], 3)
         for ctx, qid in traced:
-            tracer.record(ctx, "device_execute", cid, t0, t1,
+            tracer.record(ctx, name, cid, t0, t1,
                           span_id=batch_span, parent_id=qid,
                           links=links, attrs=attrs)
+        return batch_span
 
-    async def _run_batch(self, batch: Batch) -> None:
+    async def _run_batch(self, batch: Batch,
+                         tier: Optional[int] = None) -> None:
+        rt = None if tier is None else self._router.tiers[tier]
+        engine = self.engine if rt is None else rt.engine
         try:
-            dispatch = getattr(self.engine, "dispatch", None)
+            dispatch = getattr(engine, "dispatch", None)
             t0 = time.perf_counter()
             timings = None
             if dispatch is not None:
@@ -490,20 +569,23 @@ class InferenceBolt(Bolt):
                 out = await asyncio.wrap_future(handle.future)
                 timings = handle.timings
             else:
-                # Engines without the split-phase surface (degrade path,
-                # custom test doubles): the serialized predict.
-                out = await asyncio.to_thread(self.engine.predict,
+                # Engines without the split-phase surface (custom test
+                # doubles): the serialized predict.
+                out = await asyncio.to_thread(engine.predict,
                                               batch.stack())
             t1 = time.perf_counter()
             self._m_device_ms.observe((t1 - t0) * 1e3)
+            if rt is not None and rt.m_device is not None:
+                rt.m_device.observe((t1 - t0) * 1e3)
             if timings:
                 for key, _ in DEVICE_SUBSTAGES:
                     if key in timings:
                         self._m_substage[key].observe(timings[key])
             self._m_batch.observe(batch.size)
             self._m_infer.inc(batch.size)
+            batch_span = None
             if self._tracer is not None and self._tracer.active:
-                self._trace_batch(batch, t0, t1, timings)
+                batch_span = self._trace_batch(batch, t0, t1, timings, tier)
             if self._flight is not None:
                 # Sampled (throttled) batch-formed events: enough to see
                 # batch-size/device-time behavior in a post-mortem without
@@ -512,8 +594,18 @@ class InferenceBolt(Bolt):
                     "batch_formed", throttle_s=1.0,
                     component=self.context.component_id,
                     size=batch.size, records=len(batch.items),
-                    device_ms=round((t1 - t0) * 1e3, 3))
-            for item, preds in batch.split(out):
+                    device_ms=round((t1 - t0) * 1e3, 3),
+                    **({} if rt is None else {"tier": tier,
+                                              "model": rt.name}))
+            if rt is None:
+                emit = batch.split(out)
+                escalated, info = (), None
+            else:
+                level = (int(self._shed_gauge.value)
+                         if self.qos is not None else 0)
+                emit, escalated, info = self._router.decide(
+                    batch, out, tier, level)
+            for item, preds in emit:
                 anchor = self._anchor_of(item)
                 with span(self.context.metrics, self.context.component_id,
                           "encode"):
@@ -523,8 +615,17 @@ class InferenceBolt(Bolt):
                     anchors=[anchor],
                 )
                 self._complete(item, True)
+            if escalated:
+                if self._flight is not None:
+                    self._flight.event(
+                        "cascade_escalation", throttle_s=1.0,
+                        component=self.context.component_id, **info)
+                await self._escalate(escalated, tier + 1, batch_span)
         except Exception as e:
-            # Device/compile failure: fail every tuple in the batch -> replay.
+            # Device/compile failure: fail every tuple in the batch ->
+            # spout replay (an escalation tier failure fails the ORIGINAL
+            # tuples — _complete unwraps Escalated — so the records replay
+            # from tier 0, never half-served).
             self.collector.report_error(e)
             for item in batch.items:
                 self._complete(item.payload, False)
@@ -532,6 +633,28 @@ class InferenceBolt(Bolt):
             self._dispatch_sem.release()
             # Freed a slot: eagerly pull whatever queued while we ran.
             self._kick_flush()
+
+    async def _escalate(self, items, tier: int, link_span) -> None:
+        """Re-batch the low-confidence residue into the next tier's
+        batcher, preserving each record's original data/deadline/lane.
+        Ready batches go through _spawn_dispatch (never awaited: this
+        coroutine runs under _run_batch, which holds a semaphore slot)."""
+        rt = self._router.tiers[tier]
+        b = rt.batcher
+        for it in items:
+            payload = it.payload
+            if isinstance(payload, Escalated):
+                payload.link_span = link_span
+            else:
+                payload = Escalated(payload, link_span)
+            if self.qos is not None:
+                batch = b.add(payload, it.data, ts=it.ts, lane=it.lane)
+            else:
+                batch = b.add(payload, it.data, ts=it.ts)
+            while batch is not None:
+                self._spawn_dispatch(batch, tier)
+                batch = b.take_ready()
+        self._kick_flush()
 
     async def swap_model(self, model_cfg: ModelConfig) -> None:
         """Zero-downtime model swap (the reference ships its model inside
@@ -552,24 +675,42 @@ class InferenceBolt(Bolt):
             eng.warmup()
             return eng
 
+        old_engine = self.engine
         new_engine = await asyncio.to_thread(build)
+        if getattr(self, "_router", None) is not None:
+            # The cascade tier serving the flagship follows the swap (the
+            # tiers sharing the old engine object by identity — normally
+            # just the last one).
+            for rt in self._router.tiers:
+                if rt.engine is old_engine:
+                    rt.engine = new_engine
+                    rt.model_cfg = model_cfg
         self.engine = new_engine
         self.model_cfg = model_cfg
 
     async def tick(self) -> None:
-        batch = self.batcher.take_if_due()
-        while batch is not None:
-            await self._dispatch(batch)
-            batch = self.batcher.take_ready()
+        for tier, b in self._sources:
+            batch = b.take_if_due()
+            while batch is not None:
+                await self._dispatch(batch, tier)
+                batch = b.take_ready()
 
     async def flush(self) -> None:
         """Drain: dispatch whatever is pending and wait for in-flight
-        batches, so a graceful stop never strands undecoded acks."""
-        batch = self.batcher.take_all()
-        if batch is not None:
-            await self._dispatch(batch)
-        while self._inflight:
-            await asyncio.gather(*list(self._inflight), return_exceptions=True)
+        batches, so a graceful stop never strands undecoded acks. Loops
+        because finishing a cascade tier's batches can re-fill a LATER
+        tier's batcher with escalated residue."""
+        while True:
+            for tier, b in self._sources:
+                batch = b.take_all()
+                while batch is not None:
+                    await self._dispatch(batch, tier)
+                    batch = b.take_all()
+            while self._inflight:
+                await asyncio.gather(
+                    *list(self._inflight), return_exceptions=True)
+            if not self._pending():
+                return
 
     def cleanup(self) -> None:
         if self._flush_task is not None and not self._flush_task.done():

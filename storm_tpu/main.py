@@ -48,6 +48,10 @@ def build_standard_topology(cfg: Config, broker):
     # lane; the operator carries it through to the sink (per-lane e2e
     # histograms) via passthrough.
     qos = cfg.qos if cfg.qos.enabled else None
+    # Confidence-gated cascade (config.cascade): tiered serving inside the
+    # inference bolt — cheap tiers accept the easy records, only the
+    # low-confidence residue escalates to the flagship.
+    cascade = cfg.cascade if cfg.cascade.enabled else None
     tb = TopologyBuilder()
     tb.set_spout(
         "kafka-spout",
@@ -60,6 +64,7 @@ def build_standard_topology(cfg: Config, broker):
     tb.set_bolt(
         "inference-bolt",
         InferenceBolt(cfg.model, cfg.batch, cfg.sharding, qos=qos,
+                      cascade=cascade,
                       passthrough=("qos_lane",) if qos else ()),
         parallelism=cfg.topology.inference_parallelism,
     ).shuffle_grouping("kafka-spout")
@@ -92,6 +97,7 @@ def build_null_engine_topology(cfg: Config, broker):
     from storm_tpu.runtime import TopologyBuilder
 
     qos = cfg.qos if cfg.qos.enabled else None
+    cascade = cfg.cascade if cfg.cascade.enabled else None
     engine = NullEngine(cfg.model.input_shape, cfg.model.num_classes)
     tb = TopologyBuilder()
     tb.set_spout(
@@ -105,7 +111,7 @@ def build_null_engine_topology(cfg: Config, broker):
     tb.set_bolt(
         "inference-bolt",
         InferenceBolt(cfg.model, cfg.batch, cfg.sharding, engine=engine,
-                      warmup=False, qos=qos,
+                      warmup=False, qos=qos, cascade=cascade,
                       passthrough=("qos_lane",) if qos else ()),
         parallelism=cfg.topology.inference_parallelism,
     ).shuffle_grouping("kafka-spout")
@@ -137,6 +143,7 @@ def build_multi_model_topology(cfg: Config, broker):
     if not cfg.pipelines:
         raise ValueError("build_multi_model_topology needs cfg.pipelines")
     qos = cfg.qos if cfg.qos.enabled else None  # shared across pipelines
+    cascade = cfg.cascade if cfg.cascade.enabled else None
     tb = TopologyBuilder()
     for p in cfg.pipelines:
         spout_id = f"{p.name}-spout"
@@ -152,6 +159,7 @@ def build_multi_model_topology(cfg: Config, broker):
         tb.set_bolt(
             infer_id,
             InferenceBolt(p.model, p.batch, p.sharding, qos=qos,
+                          cascade=cascade,
                           passthrough=("qos_lane",) if qos else ()),
             parallelism=p.inference_parallelism,
         ).shuffle_grouping(spout_id)

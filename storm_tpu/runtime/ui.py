@@ -21,6 +21,8 @@ Read routes
     GET /api/v1/topology/{name}/traces        slowest/recent trace trees +
                                               flight tail (?n=20)
     GET /api/v1/topology/{name}/flight        flight-recorder events only
+    GET /api/v1/topology/{name}/qos           admission/shed state
+    GET /api/v1/topology/{name}/cascade       per-tier engines + escalation
     GET /metrics                              Prometheus text exposition
 
 Admin routes (POST, like Storm UI's topology actions)
@@ -409,6 +411,32 @@ class UIServer:
                         {"direction": d, "from": a, "to": b}
                         for d, a, b in shedder.decisions]
                 return 200, out
+            if action == "cascade":
+                # Tiered-serving state: per-tier engine attribution (model,
+                # checkpoint, gate, HBM) from every cascading bolt executor
+                # plus the escalation-rate gauge and the process engine
+                # inventory — a multi-engine bolt reads as N sized tiers,
+                # not one opaque blob.
+                if method != "GET":
+                    return 405, {"error": "use GET"}
+                bolts = []
+                for cid, execs in getattr(rt, "bolt_execs", {}).items():
+                    for e in execs:
+                        router = getattr(e.bolt, "_router", None)
+                        if router is None:
+                            continue
+                        bolts.append({
+                            "component": cid, "task": e.task_index,
+                            "escalation_rate": round(
+                                router.escalation_rate(), 4),
+                            "tiers": router.inventory()})
+                snap = await asyncio.to_thread(rt.metrics.snapshot)
+                from storm_tpu.infer.engine import engine_inventory
+
+                return 200, {
+                    "topology": rt.name, "bolts": bolts,
+                    "cascade": snap.get("cascade", {}),
+                    "engines": await asyncio.to_thread(engine_inventory)}
             if method != "POST":
                 return 405, {"error": "topology actions are POST"}
             return await self._action(rt, action, {**query, **body})
