@@ -294,6 +294,50 @@ def sample_stats(samples) -> dict:
             "value_min": s[0], "value_max": s[-1]}
 
 
+def run_interleaved(arms, repeats, run_cell) -> dict:
+    """The interleaved-A/B cell driver shared by --wire-compare,
+    --cascade-compare, and --parallelism-compare: repeats are interleaved
+    at CELL level (arm1, arm2, ..., arm1, arm2, ...) so host/tunnel drift
+    hits every arm equally instead of biasing whichever ran last
+    (BENCH_NOTES honesty protocol). Returns {arm: [run_cell(arm, rep),
+    ...]} with samples in rep order."""
+    samples = {arm: [] for arm in arms}
+    for rep in range(repeats):
+        for arm in arms:
+            samples[arm].append(run_cell(arm, rep))
+    return samples
+
+
+def timed_drain_window(size_fn, warm, total, deadline_s=300.0) -> tuple:
+    """Ack-gated warm->last measurement window over a pre-produced
+    backlog: poll ``size_fn()`` until it reaches ``total`` (or the
+    deadline), timing from the moment it crossed ``warm`` — producer
+    pacing, topology startup, and first-batch compile all land before
+    the window. Returns ``(elapsed_s, done)``; ``elapsed_s`` is NaN when
+    the warm threshold was never reached."""
+    deadline = time.time() + deadline_s
+    t0 = None
+    while time.time() < deadline:
+        n = size_fn()
+        if t0 is None and n >= warm:
+            t0 = time.perf_counter()
+        if n >= total:
+            break
+        time.sleep(0.005)
+    t1 = time.perf_counter()
+    return (t1 - t0 if t0 is not None else float("nan")), size_fn()
+
+
+def arm_stats(samples) -> dict:
+    """Per-arm rate summary in the shape every interleaved artifact rows
+    use: median headline + min/max + the raw samples."""
+    st = sample_stats(samples)
+    return {"msgs_per_sec": st.pop("value"),
+            "msgs_per_sec_min": st.pop("value_min"),
+            "msgs_per_sec_max": st.pop("value_max"),
+            "samples": st["throughput_samples"]}
+
+
 def _new_capture_session() -> str:
     """Artifact cross-reference id (VERDICT r4 weak #2): every bench
     emission carries one, and counterpart artifacts quote it, so two
@@ -922,17 +966,8 @@ def run_wire_compare(args) -> dict:
             producer.produce(cfg.broker.input_topic, payloads[i % len(payloads)])
         out = cfg.broker.output_topic
         cluster.submit(prefix, cfg, placement, builder=builder)
-        deadline = time.time() + 300
-        t0 = None
-        while time.time() < deadline:
-            n = stub.topic_size(out)
-            if t0 is None and n >= warm:
-                t0 = time.perf_counter()
-            if n >= total:
-                break
-            time.sleep(0.005)
-        t1 = time.perf_counter()
-        done = stub.topic_size(out)
+        elapsed, done = timed_drain_window(
+            lambda: stub.topic_size(out), warm, total)
         if not cluster.drain(timeout_s=30):
             log(f"  {prefix}: drain timed out")
         snap = cluster.metrics()
@@ -945,10 +980,10 @@ def run_wire_compare(args) -> dict:
                       cfg.broker.dead_letter_topic):
                 for p in range(stub.partitions):
                     stub._logs.pop((t, p), None)
-        if t0 is None or done < total:
+        if elapsed != elapsed or done < total:
             raise RuntimeError(
                 f"{prefix}: only {done}/{total} outputs before deadline")
-        return n_msgs / (t1 - t0), replays
+        return n_msgs / elapsed, replays
 
     # (n_msgs, warm) per payload size: warm > max_spout_pending so timing
     # starts after the in-flight flood, and n_msgs sized for multi-second
@@ -969,20 +1004,24 @@ def run_wire_compare(args) -> dict:
                 for instances in (1, 8):
                     n_msgs, warm = sizing[instances]
                     payloads = mk_payloads(instances)
-                    samples = {"json": [], "binary": []}
-                    replays = {"json": [], "binary": []}
-                    for rep in range(repeats):
-                        for wire in ("json", "binary"):
-                            run_id += 1
-                            prefix = f"w{run_id}"
-                            rate, rp = run_once(
-                                cluster, prefix, builder, wire, instances,
-                                n_msgs, warm, payloads)
-                            samples[wire].append(rate)
-                            replays[wire].append(rp)
-                            log(f"  {workload} x{instances} {wire} "
-                                f"rep{rep}: {rate:.1f} msg/s"
-                                + (f" ({rp} replays)" if rp else ""))
+
+                    def cell(wire, rep):
+                        nonlocal run_id
+                        run_id += 1
+                        rate, rp = run_once(
+                            cluster, f"w{run_id}", builder, wire, instances,
+                            n_msgs, warm, payloads)
+                        log(f"  {workload} x{instances} {wire} "
+                            f"rep{rep}: {rate:.1f} msg/s"
+                            + (f" ({rp} replays)" if rp else ""))
+                        return rate, rp
+
+                    cells = run_interleaved(("json", "binary"), repeats,
+                                            cell)
+                    samples = {w: [r for r, _ in cells[w]]
+                               for w in ("json", "binary")}
+                    replays = {w: [p for _, p in cells[w]]
+                               for w in ("json", "binary")}
                     row = {
                         "workload": workload,
                         "builder": builder,
@@ -992,14 +1031,8 @@ def run_wire_compare(args) -> dict:
                         "warmup_messages": warm,
                     }
                     for wire in ("json", "binary"):
-                        st = sample_stats(samples[wire])
-                        row[wire] = {
-                            "msgs_per_sec": st.pop("value"),
-                            "msgs_per_sec_min": st.pop("value_min"),
-                            "msgs_per_sec_max": st.pop("value_max"),
-                            "samples": st["throughput_samples"],
-                            "replays": replays[wire],
-                        }
+                        row[wire] = dict(arm_stats(samples[wire]),
+                                         replays=replays[wire])
                     row["speedup_binary_vs_json"] = round(
                         row["binary"]["msgs_per_sec"]
                         / row["json"]["msgs_per_sec"], 3)
@@ -1157,35 +1190,26 @@ def run_cascade_compare(args) -> dict:
                            payloads[i % len(payloads)], partition=0)
         topo = build_standard_topology(cfg, broker)
         cluster.submit_topology(name, cfg, topo)
-        deadline = time.time() + 300
-        t0 = None
-        while time.time() < deadline:
-            n = broker.topic_size(cfg.broker.output_topic)
-            if t0 is None and n >= warm:
-                t0 = time.perf_counter()
-            if n >= total:
-                break
-            time.sleep(0.005)
-        t1 = time.perf_counter()
-        done = broker.topic_size(cfg.broker.output_topic)
+        elapsed, done = timed_drain_window(
+            lambda: broker.topic_size(cfg.broker.output_topic), warm, total)
         dead = broker.topic_size(cfg.broker.dead_letter_topic)
         cluster.kill_topology(name, wait_secs=2)
-        if t0 is None or done < total:
+        if elapsed != elapsed or done < total:
             raise RuntimeError(f"{name}: only {done}/{total} outputs "
                                f"({dead} dead-lettered) before deadline")
-        return (total - warm) / (t1 - t0)
+        return (total - warm) / elapsed
 
-    samples = {"flagship": [], "cascade": []}
     total = warm + n_msgs
     cluster = LocalCluster()
     try:
-        for rep in range(repeats):
-            for arm in ("flagship", "cascade"):
-                rate = run_once(cluster, f"cc-{arm}-{rep}",
-                                mk_cfg(arm == "cascade"), total)
-                samples[arm].append(rate)
-                log(f"  {arm} rep{rep}: {rate:.1f} msg/s "
-                    f"({rate * instances:.0f} img/s)")
+        def cell(arm, rep):
+            rate = run_once(cluster, f"cc-{arm}-{rep}",
+                            mk_cfg(arm == "cascade"), total)
+            log(f"  {arm} rep{rep}: {rate:.1f} msg/s "
+                f"({rate * instances:.0f} img/s)")
+            return rate
+
+        samples = run_interleaved(("flagship", "cascade"), repeats, cell)
 
         # ---- observability evidence (sampled run) ------------------------
         # One cascade run at sample_rate=1.0, small enough to read back:
@@ -1247,14 +1271,9 @@ def run_cascade_compare(args) -> dict:
            "payload_bytes": len(payloads[0]),
            "messages_timed": n_msgs, "warmup_messages": warm}
     for arm in ("flagship", "cascade"):
-        st = sample_stats(samples[arm])
-        row[arm] = {"msgs_per_sec": st.pop("value"),
-                    "msgs_per_sec_min": st.pop("value_min"),
-                    "msgs_per_sec_max": st.pop("value_max"),
-                    "images_per_sec": round(
-                        st["throughput_samples"][len(st["throughput_samples"])
-                                                 // 2] * instances, 1),
-                    "samples": st["throughput_samples"]}
+        st = arm_stats(samples[arm])
+        st["images_per_sec"] = round(st["msgs_per_sec"] * instances, 1)
+        row[arm] = st
     speedup = round(row["cascade"]["msgs_per_sec"]
                     / row["flagship"]["msgs_per_sec"], 3)
     row["speedup_cascade_vs_flagship"] = speedup
@@ -1281,6 +1300,199 @@ def run_cascade_compare(args) -> dict:
                     "accuracy artifact",
         "chips": n_dev,
         "config": "cascade-compare",
+        "capture_session": _new_capture_session(),
+        "code_version": _code_version(),
+    }
+
+
+def run_parallelism_compare(args) -> dict:
+    """``--parallelism-compare``: the continuous-batching claim as one
+    artifact (ROADMAP item 3). Four arms over the same lenet5 topology —
+    {deadline, continuous} x {1, 8 inference bolts} — at the operating
+    point where the measured 8-bolts-slower inversion lives: small
+    bucket, short per-task deadline, so 8 deadline batchers fragment the
+    stream into partial buckets while the continuous queue coalesces all
+    replicas (they share one engine via the process cache, hence ONE
+    slot-level queue) into full ones.
+
+    Protocol (BENCH_NOTES honesty rules, shared helpers with
+    wire-/cascade-compare): repeats interleaved at cell level; backlog
+    pre-produced; ack-gated warm->last windows; median-of-N with raw
+    samples in the artifact. A second, PACED phase offers the same
+    common rate (half the slowest arm's measured capacity) to the two
+    8-bolt modes and reports batch_fill — fragmentation must be read at
+    equal offered rate, not equal pressure, because a full-speed drain
+    keeps even per-task batchers full."""
+    import jax
+
+    from storm_tpu.config import BatchConfig
+    from storm_tpu.connectors import MemoryBroker
+    from storm_tpu.infer.continuous import _reset_registry, registry_stats
+    from storm_tpu.runtime.cluster import LocalCluster
+
+    cfg = CONFIGS["lenet5"]
+    n_dev = len(jax.devices())
+    repeats = max(1, args.repeats)
+    # The timed backlog must well exceed the 8-bolt continuous path's
+    # aggregate outstanding-row cap (8 tasks x max_inflight*max_batch =
+    # 1024 rows): below that, nothing ever blocks the consume loop, the
+    # whole backlog enqueues before the first emit flushes, and the
+    # warm->last window collapses to the final burst (measured 60k+
+    # "msg/s" on a ~2.5k msg/s topology).
+    n_msgs = min(args.messages, 4096)
+    warm = max(1024, n_msgs // 4)
+    total = warm + n_msgs
+    ipm = args.instances_per_msg
+    payloads = make_payloads(cfg, instances_per_msg=ipm)
+
+    def batch_cfg(continuous: bool) -> BatchConfig:
+        return BatchConfig(max_batch=64, max_wait_ms=5.0, buckets=(64,),
+                           max_inflight=args.inflight or 2,
+                           continuous=continuous)
+
+    arms = ("deadline-1", "deadline-8", "continuous-1", "continuous-8")
+
+    def arm_params(arm):
+        mode, bolts = arm.rsplit("-", 1)
+        return mode == "continuous", int(bolts)
+
+    cluster = LocalCluster()
+    fills = {}
+    try:
+        def run_cell(arm, rep) -> float:
+            continuous, bolts = arm_params(arm)
+            # Fresh continuous queue per cell: the per-engine registry
+            # outlives topologies (the engine cache does too), and a
+            # stale queue would hold the PREVIOUS cell's metrics binding.
+            _reset_registry()
+            c = dict(cfg, bolts=bolts)
+            broker = MemoryBroker(default_partitions=4)
+            run_cfg, topo = build_topology(c, broker, batch_cfg(continuous))
+            for i in range(total):
+                broker.produce("input", payloads[i % len(payloads)])
+            name = f"pc-{arm}-{rep}"
+            cluster.submit_topology(name, run_cfg, topo)
+            elapsed, done = timed_drain_window(
+                lambda: broker.topic_size("output"), warm, total)
+            h = cluster.metrics(name).get(
+                "inference-bolt", {}).get("batch_fill") or {}
+            cluster.kill_topology(name, wait_secs=2)
+            if elapsed != elapsed or done < total:
+                raise RuntimeError(f"{name}: only {done}/{total} outputs "
+                                   "before deadline")
+            rate = n_msgs / elapsed
+            log(f"  {arm} rep{rep}: {rate:.1f} msg/s "
+                f"(drain batch_fill p50={h.get('p50')})")
+            return rate
+
+        samples = run_interleaved(arms, repeats, run_cell)
+        med = {arm: sample_stats(samples[arm])["value"] for arm in arms}
+
+        # ---- paced common-rate phase: batch_fill at equal offered rate ---
+        paced_s = max(args.latency_seconds, 8.0)
+
+        def paced_cell(mode, rate) -> dict:
+            _reset_registry()
+            c = dict(cfg, bolts=8)
+            broker = MemoryBroker(default_partitions=4)
+            run_cfg, topo = build_topology(
+                c, broker, batch_cfg(mode == "continuous"))
+            name = f"pc-fill-{mode}"
+            cluster.submit_topology(name, run_cfg, topo)
+            # Warm outside the fill window (compile + first batches).
+            base = broker.topic_size("output")
+            for i in range(64):
+                broker.produce("input", payloads[i % len(payloads)])
+            if not await_outputs(
+                    lambda: broker.topic_size("output") - base, 64,
+                    grace_s=120.0):
+                cluster.kill_topology(name, wait_secs=2)
+                raise RuntimeError(f"{name}: fill warmup never drained")
+            cluster.reset_histogram(name, "inference-bolt", "batch_fill")
+            base = broker.topic_size("output")
+            sent, aborted = offer_load(
+                lambda i: broker.produce("input",
+                                         payloads[i % len(payloads)]),
+                rate, paced_s,
+                backlog_fn=lambda s: s - (broker.topic_size("output")
+                                          - base))
+            drained = await_outputs(
+                lambda: broker.topic_size("output") - base, sent,
+                grace_s=60.0)
+            h = cluster.metrics(name).get(
+                "inference-bolt", {}).get("batch_fill") or {}
+            queue = registry_stats() if mode == "continuous" else []
+            cluster.kill_topology(name, wait_secs=2)
+            out = {
+                "offered_msg_s": round(rate, 1),
+                "batch_fill_p50": h.get("p50"),
+                "batch_fill_mean": h.get("mean"),
+                "batches": h.get("count"),
+                "valid": bool(not aborted and drained),
+            }
+            if queue:
+                out["continuous_queue"] = queue[0]
+            log(f"  paced {mode} @ {rate:.0f} msg/s: "
+                f"batch_fill p50={h.get('p50')} over {h.get('count')} "
+                f"batches{'' if out['valid'] else ' [backlog/abort]'}")
+            return out
+
+        # Both modes must see the SAME offered rate (fragmentation is a
+        # function of arrival rate, not of pressure) — so on a backlog
+        # abort in EITHER mode, halve and rerun BOTH at the new rate.
+        # 0.7x the slower 8-BOLT arm's capacity: both paced cells run 8
+        # bolts, so the 1-bolt medians have no business in the floor.
+        paced_rate = max(4.0, 0.7 * min(med["deadline-8"],
+                                        med["continuous-8"]))
+        for _attempt in range(3):
+            fills = {mode: paced_cell(mode, paced_rate)
+                     for mode in ("deadline", "continuous")}
+            if all(f["valid"] for f in fills.values()):
+                break
+            paced_rate = max(4.0, paced_rate / 2)
+            log(f"  paced phase oversaturated; retrying both modes "
+                f"@ {paced_rate:.0f} msg/s")
+    finally:
+        cluster.shutdown()
+
+    rows = []
+    for arm in arms:
+        continuous, bolts = arm_params(arm)
+        rows.append(dict(
+            {"arm": arm,
+             "mode": "continuous" if continuous else "deadline",
+             "bolts": bolts},
+            **arm_stats(samples[arm])))
+    d1, d8 = med["deadline-1"], med["deadline-8"]
+    c1, c8 = med["continuous-1"], med["continuous-8"]
+    fill_d = fills["deadline"].get("batch_fill_p50")
+    fill_c = fills["continuous"].get("batch_fill_p50")
+    return {
+        "metric": "parallelism_compare_lenet5",
+        "value": round(c8 / d8, 3) if d8 else None,
+        "unit": ("continuous-8 / deadline-8 msgs/s (medians of "
+                 "interleaved ack-gated drains; records/s = msgs/s * "
+                 "instances_per_msg)"),
+        "rows": rows,
+        "medians_msgs_per_sec": {k: round(v, 1) for k, v in med.items()},
+        "scaling_deadline_8v1": round(d8 / d1, 3) if d1 else None,
+        "scaling_continuous_8v1": round(c8 / c1, 3) if c1 else None,
+        "continuous8_ge_continuous1": bool(c8 >= c1),
+        "batch_fill_paced": fills,
+        "continuous_fill_gt_deadline": bool(
+            fill_c is not None and fill_d is not None and fill_c > fill_d),
+        "messages_timed": n_msgs,
+        "warmup_messages": warm,
+        "instances_per_msg": ipm,
+        "max_batch": 64,
+        "max_wait_ms": 5.0,
+        "repeats": repeats,
+        "protocol": ("interleaved A/B per cell; median-of-N; ack-gated "
+                     "warm->last window over a pre-produced backlog; "
+                     "paced common-rate phase (0.5x slowest arm's "
+                     "capacity) for batch_fill at equal offered rate"),
+        "chips": n_dev,
+        "config": "parallelism-compare",
         "capture_session": _new_capture_session(),
         "code_version": _code_version(),
     }
@@ -2448,6 +2660,13 @@ def main() -> None:
                          "ack-gated windows, operating point from "
                          "ACCURACY_CASCADE_r09.json) + a sampled run "
                          "capturing the escalation evidence")
+    ap.add_argument("--parallelism-compare", action="store_true",
+                    help="continuous-batching evidence: {deadline,"
+                         "continuous} x {1,8 bolts} on lenet5 at the "
+                         "fragmentation operating point (small bucket, "
+                         "short deadline), interleaved median-of-N, plus "
+                         "a paced equal-rate batch_fill phase -> "
+                         "BENCH_CONTBATCH artifact")
     ap.add_argument("--wire-compare", action="store_true",
                     help="A/B the JSON vs binary inter-worker tuple wire "
                          "on a 3-worker CPU mesh (NullEngine framework "
@@ -2474,6 +2693,9 @@ def main() -> None:
         return
     if args.wire_compare:
         print(json.dumps(run_wire_compare(args)))
+        return
+    if args.parallelism_compare:
+        print(json.dumps(run_parallelism_compare(args)))
         return
     if args.slo_sweep:
         print(json.dumps(run_slo_sweep(args)))

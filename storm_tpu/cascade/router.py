@@ -191,15 +191,18 @@ class CascadeRouter:
         wrapper.partial[wrapper.row_idx] = preds
         return wrapper.partial
 
-    def decide(self, batch: Batch, out, tier_idx: int, shed_level: int):
-        """Split one fetched tier output into accepts and escalations.
+    def decide_item(self, payload, data, preds, lane, tier_idx: int,
+                    shed_level: int, ts=None):
+        """Accept-or-escalate ONE record's tier output.
 
-        Returns ``(accepted, escalated, info)``: ``accepted`` is
-        ``[(payload, merged_preds)]`` ready for the operator's emit+ack
-        loop, ``escalated`` the per-record residue items (original
-        data/ts/lane preserved, data sliced to the uncertain rows) to
-        re-batch into tier ``tier_idx + 1``, and ``info`` the decision
-        stats for the flight-recorder event.
+        Returns ``(merged_preds_or_None, residue_or_None, info)``: when
+        the record (or its last undecided rows) accepts here,
+        ``merged_preds`` is the full output in original row order and
+        ``residue`` is None; when any rows escalate, ``merged_preds`` is
+        None and ``residue`` is the :class:`_Residue` for tier
+        ``tier_idx + 1`` (data sliced to the uncertain rows, lane/ts
+        preserved). ``info`` carries this record's row counts
+        (accepted/escalated/pinned/budget_capped).
 
         Decision granularity is the ROW: each row accepts where its own
         uncertainty clears the tier's threshold, and only the uncertain
@@ -208,89 +211,107 @@ class CascadeRouter:
         collapses to flagship-only as record width grows: P(all n rows
         confident) -> 0). Accepted rows park in the record's
         :class:`Escalated` partial buffer; the record emits once, merged
-        in original row order, when its last row decides. Pinned
-        (shed) and budget-capped records accept all remaining rows at
-        this tier. Counters (``cascade_accepted_tier{i}``,
+        in original row order, when its last row decides. Pinned (shed)
+        and budget-capped records accept all remaining rows at this
+        tier. Counters (``cascade_accepted_tier{i}``,
         ``cascade_escalations``, lane counters, the budget window) all
         count ROWS, which for single-instance records is identical to
-        counting records."""
+        counting records. This is the unit both dispatch paths share:
+        the batch path (:meth:`decide`) loops it over a fetched batch;
+        the continuous path calls it per resolved submission."""
         tier = self.tiers[tier_idx]
-        last = tier_idx == self.last_tier
-        accepted, escalated = [], []
-        rows_accepted = rows_escalated = pinned = capped = 0
-        ofs = 0
-        scores = None if last else uncertainty(
-            out, self.cfg.metric, self.cfg.temperature)
-        for it in batch.items:
-            n = it.data.shape[0]
-            preds = out[ofs:ofs + n]
-            ofs += n
-            wrapper = it.payload if isinstance(it.payload, Escalated) \
-                else None
-            if last:
-                accepted.append((it.payload, self._merge(wrapper, preds)))
-                rows_accepted += n
-                continue
-            if self.cfg.pinned(it.lane, shed_level, self.qos):
-                pinned += n
-                esc_mask = np.zeros(n, dtype=bool)
-                for _ in range(n):
-                    self._charge(tier_idx, escalate=False)
+        n = int(data.shape[0])
+        wrapper = payload if isinstance(payload, Escalated) else None
+        pinned = capped = 0
+        if tier_idx == self.last_tier:
+            esc_mask = np.zeros(n, dtype=bool)
+        elif self.cfg.pinned(lane, shed_level, self.qos):
+            pinned = n
+            esc_mask = np.zeros(n, dtype=bool)
+            for _ in range(n):
+                self._charge(tier_idx, escalate=False)
+        else:
+            row_u = uncertainty(preds, self.cfg.metric, self.cfg.temperature)
+            thr = self.cfg.threshold_for(tier_idx, lane, shed_level)
+            esc_mask = np.asarray(row_u >= thr).reshape(-1).copy()
+            # Row-order budget walk, window charges interleaved with
+            # decisions exactly as record-level gating charged them.
+            for j in range(n):
+                if esc_mask[j] and not self._budget_allows():
+                    esc_mask[j] = False
+                    capped += 1
+                self._charge(tier_idx, escalate=bool(esc_mask[j]))
+        n_esc = int(esc_mask.sum())
+        if n_esc == 0:
+            merged, residue = self._merge(wrapper, preds), None
+        else:
+            if wrapper is None:
+                wrapper = Escalated(payload)
+            if n_esc < n:
+                cur_idx = wrapper.row_idx if wrapper.row_idx is not None \
+                    else np.arange(n)
+                if wrapper.partial is None:
+                    wrapper.partial = np.zeros(
+                        (n, preds.shape[-1]), dtype=preds.dtype)
+                keep = ~esc_mask
+                wrapper.partial[cur_idx[keep]] = preds[keep]
+                wrapper.row_idx = cur_idx[esc_mask]
+                residue = _Residue(wrapper, data[esc_mask], ts, lane)
             else:
-                row_u = scores[ofs - n:ofs]
-                thr = self.cfg.threshold_for(tier_idx, it.lane, shed_level)
-                esc_mask = np.asarray(row_u >= thr).reshape(-1).copy()
-                # Row-order budget walk, window charges interleaved with
-                # decisions exactly as record-level gating charged them.
-                for j in range(n):
-                    if esc_mask[j] and not self._budget_allows():
-                        esc_mask[j] = False
-                        capped += 1
-                    self._charge(tier_idx, escalate=bool(esc_mask[j]))
-            n_esc = int(esc_mask.sum())
-            if n_esc == 0:
-                accepted.append((it.payload, self._merge(wrapper, preds)))
-                rows_accepted += n
-            else:
-                if wrapper is None:
-                    wrapper = Escalated(it.payload)
-                if n_esc < n:
-                    cur_idx = wrapper.row_idx if wrapper.row_idx is not None \
-                        else np.arange(n)
-                    if wrapper.partial is None:
-                        wrapper.partial = np.zeros(
-                            (n, preds.shape[-1]), dtype=preds.dtype)
-                    keep = ~esc_mask
-                    wrapper.partial[cur_idx[keep]] = preds[keep]
-                    wrapper.row_idx = cur_idx[esc_mask]
-                    rows_accepted += n - n_esc
-                    escalated.append(_Residue(
-                        wrapper, it.data[esc_mask], it.ts, it.lane))
-                else:
-                    escalated.append(_Residue(
-                        wrapper, it.data, it.ts, it.lane))
-                rows_escalated += n_esc
-            if self._m is not None:
-                lane = it.lane or "default"
-                self._m.counter(
-                    self._cid, f"cascade_decided_lane_{lane}").inc(n)
-                if n_esc:
-                    self._m.counter(
-                        self._cid, f"cascade_escalated_lane_{lane}").inc(
-                        n_esc)
+                residue = _Residue(wrapper, data, ts, lane)
+            merged = None
+        rows_accepted = n - n_esc
         if self._m is not None:
-            tier.m_accepted.inc(rows_accepted)
-            if rows_escalated:
-                self._m_escalations.inc(rows_escalated)
+            lane_key = lane or "default"
+            self._m.counter(
+                self._cid, f"cascade_decided_lane_{lane_key}").inc(n)
+            if n_esc:
+                self._m.counter(
+                    self._cid, f"cascade_escalated_lane_{lane_key}").inc(
+                    n_esc)
+            if rows_accepted:
+                tier.m_accepted.inc(rows_accepted)
+            if n_esc:
+                self._m_escalations.inc(n_esc)
             if capped:
                 self._m_capped.inc(capped)
             if pinned:
                 self._m_pinned.inc(pinned)
             self._g_rate.set(self.escalation_rate())
-        info = {"tier": tier_idx, "model": tier.name,
-                "accepted": rows_accepted, "escalated": rows_escalated,
-                "pinned": pinned, "budget_capped": capped,
-                "escalation_rate": round(self.escalation_rate(), 4)}
+        info = {"accepted": rows_accepted, "escalated": n_esc,
+                "pinned": pinned, "budget_capped": capped}
+        return merged, residue, info
+
+    def decide(self, batch: Batch, out, tier_idx: int, shed_level: int):
+        """Split one fetched tier output into accepts and escalations.
+
+        Returns ``(accepted, escalated, info)``: ``accepted`` is
+        ``[(payload, merged_preds)]`` ready for the operator's emit+ack
+        loop, ``escalated`` the per-record residue items (original
+        data/ts/lane preserved, data sliced to the uncertain rows) to
+        re-batch into tier ``tier_idx + 1``, and ``info`` the decision
+        stats for the flight-recorder event. Each record's decision is
+        one :meth:`decide_item` call — the same unit the continuous
+        batcher drives per resolved submission."""
+        accepted, escalated = [], []
+        agg = {"accepted": 0, "escalated": 0, "pinned": 0,
+               "budget_capped": 0}
+        ofs = 0
+        for it in batch.items:
+            n = it.data.shape[0]
+            preds = out[ofs:ofs + n]
+            ofs += n
+            merged, residue, info = self.decide_item(
+                it.payload, it.data, preds, it.lane, tier_idx, shed_level,
+                ts=it.ts)
+            if residue is None:
+                accepted.append((it.payload, merged))
+            else:
+                escalated.append(residue)
+            for k in agg:
+                agg[k] += info[k]
+        info = {"tier": tier_idx, "model": self.tiers[tier_idx].name,
+                **agg, "escalation_rate": round(self.escalation_rate(), 4)}
         return accepted, escalated, info
 
     def _charge(self, tier_idx: int, escalate: bool) -> None:

@@ -59,8 +59,24 @@ class BatchConfig:
     # buffer from dispatch until its fetch completes. 0 = auto
     # (pipeline_depth + 1, so a dispatch never waits on a recycling fetch).
     staging_pool: int = 0
+    # Per-engine continuous batching: batch formation moves out of the
+    # operator into one slot-level queue per shared engine
+    # (storm_tpu/infer/continuous.py). All replicas, the serve
+    # cross-batcher, and cascade escalations co-batch; a dispatcher
+    # refills a pipeline-ring slot the moment it frees instead of
+    # waiting for a per-bolt deadline tick. False keeps the legacy
+    # per-operator MicroBatcher/LaneBatcher path.
+    continuous: bool = False
+    # Fairness starvation bound for the continuous queue's weighted
+    # round-robin: a tenant:lane key passed over for this many batch
+    # formations is served first in the next one.
+    starvation_rounds: int = 4
 
     def __post_init__(self) -> None:
+        if int(self.starvation_rounds) < 1:
+            raise ValueError(
+                "batch.starvation_rounds must be >= 1, got "
+                f"{self.starvation_rounds!r}")
         if int(self.pipeline_depth) < 0:
             raise ValueError(
                 f"batch.pipeline_depth must be >= 0, got {self.pipeline_depth!r}")

@@ -1,0 +1,566 @@
+"""Per-engine continuous batching: one slot-level queue feeds the device.
+
+The deadline micro-batchers (:class:`~storm_tpu.infer.batcher.MicroBatcher`,
+:class:`~storm_tpu.qos.lanes.LaneBatcher`) form batches PER OPERATOR TASK:
+under parallelism the device sees each replica's fragment — the measured
+cause of the 8-bolts-slower-than-1 inversion (ROADMAP item 3). BatchGen
+(PAPERS.md) argues batch formation must be decoupled from operator topology
+and run continuously at the device. This module is that decoupling: every
+replica of an inference bolt, the gRPC serve path's cross-batcher, and
+cascade escalation residues all ``submit`` rows into ONE queue per shared
+engine, and a dedicated dispatcher thread refills a pipeline-ring slot the
+moment it frees (extending the split-phase ring of
+:mod:`storm_tpu.infer.engine`) instead of waiting for a per-bolt deadline
+tick.
+
+Dispatch rule (work-conserving slot refill):
+
+- ``max_batch`` rows pending  -> dispatch (the ring provides backpressure);
+- a ring slot is free AND at least one batch is already in flight ->
+  dispatch immediately (the freed-slot refill — batches size themselves to
+  whatever coalesced while the device worked, exactly BatchGen's
+  continuous former);
+- the device is fully idle -> ``eager`` dispatches on arrival, otherwise
+  the oldest row ages to ``max_wait_ms`` (the deadline batcher's latency
+  floor is preserved for trickle traffic).
+
+Fairness moves here from the LaneBatcher: rows queue per ``tenant:lane``
+key, batch formation orders keys earliest-deadline-first (lane deadlines
+from :class:`~storm_tpu.config.QosConfig`, so a fresh high-priority record
+still preempts queued best-effort), takes rows weighted-round-robin across
+keys (weight = lane priority), and a key passed over
+``BatchConfig.starvation_rounds`` consecutive formations is promoted to the
+front of the next batch regardless of deadline order.
+
+Exactly-once is preserved PER SOURCE: ``submit`` returns a handle whose
+future resolves to that record's own row slice — when a coalesced batch
+fails, every member future gets the exception and each source fails/replays
+its own tuples independently; nothing is shared but the device round trip.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from storm_tpu.config import BatchConfig, QosConfig
+from storm_tpu.runtime.tracing import DEVICE_SUBSTAGES
+
+
+class Submission:
+    """One submitted record inside the continuous queue.
+
+    ``future`` resolves (on the engine's fetch thread) to this record's
+    ``(n, K)`` prediction rows — or to the exception that failed the
+    coalesced batch it rode in. ``batch_span`` carries the shared device
+    span id of the batch that served it (None untraced), so a cascade
+    escalation can link the next tier's spans back."""
+
+    __slots__ = ("data", "payload", "ts", "enq", "lane", "tenant", "source",
+                 "deadline", "future", "batch_span")
+
+    def __init__(self, data, payload, ts: float, enq: float,
+                 lane: Optional[str], tenant: Optional[str], source: str,
+                 deadline: float) -> None:
+        self.data = data
+        self.payload = payload
+        self.ts = ts
+        self.enq = enq
+        self.lane = lane
+        self.tenant = tenant
+        self.source = source
+        self.deadline = deadline
+        self.future: Future = Future()
+        self.batch_span: Optional[str] = None
+
+    @property
+    def rows(self) -> int:
+        return int(self.data.shape[0])
+
+
+class ContinuousBatcher:
+    """One continuous batch former per shared engine.
+
+    Thread-safe ``submit`` from any thread (event loop, gRPC handlers,
+    completion callbacks); a single dispatcher thread owns batch formation
+    and ``engine.dispatch`` (so per-engine dispatch order is total), and
+    the engine's fetch thread resolves member futures via a done-callback.
+    The engine is held weakly — the process engine cache must stay able to
+    evict idle engines; a dead engine fails pending submissions."""
+
+    def __init__(self, engine, cfg: BatchConfig,
+                 qos: Optional[QosConfig] = None) -> None:
+        self.cfg = cfg
+        self.qos = qos if (qos is not None and qos.enabled) else None
+        self._engine_ref = weakref.ref(engine)
+        self.engine_name = getattr(
+            getattr(engine, "model_cfg", None), "name",
+            type(engine).__name__)
+        # Ring capacity: how many batches the engine keeps in flight. The
+        # dispatcher mirrors it with _inflight so "a slot just freed" is a
+        # local decision; engine.dispatch's own ring acquire stays the hard
+        # bound (an engine without a ring serializes at capacity 1).
+        self.capacity = max(1, int(getattr(engine, "ring_capacity",
+                                           getattr(engine, "pipeline_depth",
+                                                   1)) or 1))
+        self._cond = threading.Condition()
+        # tenant:lane key -> FIFO of Submissions (deadlines monotone per key)
+        self._queues: "OrderedDict[tuple, deque]" = OrderedDict()
+        self._skipped: Dict[tuple, int] = {}
+        self._pending_rows = 0
+        self._inflight = 0
+        self._force = False  # flush(): dispatch regardless of deadline
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        # ---- stats (read by the qos UI route / tests) ----
+        self.batches = 0
+        self.rows_dispatched = 0
+        self.fair_rows: Dict[tuple, int] = {}
+        self.fair_starved: Dict[tuple, int] = {}
+        self.last_batch: Optional[dict] = None
+        self._fills: deque = deque(maxlen=256)
+        # ---- observability bindings (first binder wins) ----
+        self._metrics = None
+        self._cid: Optional[str] = None
+        self._tracer = None
+        self._flight = None
+        self._trace_of: Optional[Callable] = None
+        self._link_of: Optional[Callable] = None
+        self._span_name = "device_execute"
+        self._m: Dict[str, object] = {}
+
+    # ---- binding -------------------------------------------------------------
+
+    def bind(self, metrics, component_id: str, tracer=None, flight=None,
+             trace_of: Optional[Callable] = None,
+             link_of: Optional[Callable] = None,
+             span_name: str = "device_execute") -> None:
+        """Attach the observability surfaces. Idempotent with first-binder-
+        wins semantics: replicas sharing one engine all call this; the
+        queue is per engine, so its metrics land once, under the first
+        binder's component id."""
+        with self._cond:
+            if self._metrics is not None:
+                return
+            self._metrics = metrics
+            self._cid = component_id
+            self._tracer = tracer
+            self._flight = flight
+            self._trace_of = trace_of
+            self._link_of = link_of
+            self._span_name = span_name
+            m, cid = metrics, component_id
+            self._m = {
+                "batch_size": m.histogram(cid, "batch_size"),
+                "batch_fill": m.histogram(cid, "batch_fill"),
+                "device_ms": m.histogram(cid, "device_ms"),
+                "batch_wait": m.histogram(cid, "batch_wait_ms"),
+                "disp_wait": m.histogram(cid, "dispatch_wait_ms"),
+                "infer": m.counter(cid, "instances_inferred"),
+                "coalesced": m.counter(cid, "coalesced_sources"),
+                "substage": {key: m.histogram(cid, key)
+                             for key, _ in DEVICE_SUBSTAGES},
+            }
+
+    # ---- submission ----------------------------------------------------------
+
+    def _key(self, tenant: Optional[str], lane: Optional[str]) -> tuple:
+        if self.qos is not None:
+            lane = lane if lane in self.qos.lanes else self.qos.default_lane
+        return (tenant or "default", lane or "default")
+
+    def _deadline_ms(self, lane: Optional[str]) -> float:
+        if self.qos is not None:
+            return self.qos.deadline_for(lane)
+        return self.cfg.max_wait_ms
+
+    def submit(self, data: np.ndarray, payload=None,
+               ts: Optional[float] = None, lane: Optional[str] = None,
+               tenant: Optional[str] = None,
+               source: str = "anon") -> Submission:
+        """Enqueue one record's rows; returns a :class:`Submission` whose
+        future resolves to this record's own prediction slice. Never
+        blocks — per-source backpressure (``max_inflight``) is the
+        caller's contract, the engine ring is the device-side bound."""
+        now = time.perf_counter()
+        base = ts if ts is not None else now
+        sub = Submission(
+            data, payload, base, now, lane, tenant, source,
+            base + self._deadline_ms(lane) / 1e3)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("continuous batcher is closed")
+            self._queues.setdefault(
+                self._key(tenant, lane), deque()).append(sub)
+            self._pending_rows += sub.rows
+            self._ensure_thread_locked()
+            self._cond.notify_all()
+        return sub
+
+    def flush(self) -> None:
+        """Force-dispatch everything pending (graceful drain): the force
+        flag sticks until the queue empties, so a flush moves multiple
+        max_batch-sized batches if that much is queued."""
+        with self._cond:
+            self._force = True
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        return self._pending_rows
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    # ---- dispatcher thread ---------------------------------------------------
+
+    def _ensure_thread_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"storm-tpu-contbatch-{self.engine_name}")
+            self._thread.start()
+
+    def _oldest_enq_locked(self) -> float:
+        return min(q[0].enq for q in self._queues.values() if q)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    if self._closed:
+                        return
+                    if self._pending_rows == 0:
+                        self._force = False
+                        self._cond.wait()
+                        continue
+                    now = time.perf_counter()
+                    full = self._pending_rows >= self.cfg.max_batch
+                    slot_free = self._inflight < self.capacity
+                    due = (now - self._oldest_enq_locked()) * 1e3 >= \
+                        self.cfg.max_wait_ms
+                    if full or self._force or (slot_free and (
+                            self._inflight > 0 or self.cfg.eager or due)):
+                        # full/forced batches may dispatch with every slot
+                        # busy: engine.dispatch parks on the ring — that IS
+                        # the backpressure, and the park happens on this
+                        # thread, never the event loop.
+                        break
+                    if slot_free:
+                        # Idle + non-eager: age toward the deadline.
+                        wait_s = self.cfg.max_wait_ms / 1e3 - (
+                            now - self._oldest_enq_locked())
+                        self._cond.wait(timeout=max(wait_s, 1e-4))
+                    else:
+                        # Every slot busy and not enough rows to force a
+                        # park: wait for the next slot-free notify.
+                        self._cond.wait()
+                items = self._form_locked()
+                self._inflight += 1
+            self._dispatch(items)
+
+    # ---- batch formation (EDF + weighted round-robin + starvation bound) -----
+
+    def _lane_weight(self, key: tuple) -> int:
+        if self.qos is None:
+            return 1
+        # Higher-priority lanes draw proportionally more rows per pass:
+        # weight = n_lanes - lane_index (highest lane = n, lowest = 1).
+        return len(self.qos.lanes) - self.qos.lane_index(key[1])
+
+    def _form_locked(self) -> List[Submission]:
+        """Take up to ``max_batch`` rows across keys. Key order: starved
+        keys first (passed over >= starvation_rounds formations, most
+        starved first), then earliest head-of-line deadline — EDF across
+        tenants and lanes, so LaneBatcher's preemption semantics hold.
+        Within the order, rows are taken weighted-round-robin so one
+        flooding key cannot monopolize a batch while others wait."""
+        max_rows = max(1, self.cfg.max_batch)
+        rounds = max(1, int(getattr(self.cfg, "starvation_rounds", 4)))
+        keys = [k for k, q in self._queues.items() if q]
+        starved = sorted(
+            (k for k in keys if self._skipped.get(k, 0) >= rounds),
+            key=lambda k: -self._skipped.get(k, 0))
+        rest = sorted((k for k in keys if k not in starved),
+                      key=lambda k: self._queues[k][0].deadline)
+        order = starved + rest
+        for k in starved:
+            self.fair_starved[k] = self.fair_starved.get(k, 0) + 1
+            if self._metrics is not None and self.qos is not None:
+                self._metrics.counter(
+                    "qos", f"fair_starved_{k[0]}_{k[1]}").inc()
+        items: List[Submission] = []
+        size = 0
+        capped = False
+        while not capped:
+            progressed = False
+            for k in order:
+                q = self._queues[k]
+                for _ in range(self._lane_weight(k)):
+                    if not q:
+                        break
+                    n = q[0].rows
+                    if items and size + n > max_rows:
+                        # Mirror the micro-batchers: leftovers stay pending
+                        # (an oversized single record still ships alone —
+                        # the engine pads per shape rather than crash).
+                        capped = True
+                        break
+                    items.append(q.popleft())
+                    size += n
+                    progressed = True
+                    if size >= max_rows:
+                        capped = True
+                        break
+                if capped:
+                    break
+            if not progressed:
+                break
+        self._pending_rows -= size
+        contributed: Dict[tuple, int] = {}
+        for it in items:
+            k = self._key(it.tenant, it.lane)
+            contributed[k] = contributed.get(k, 0) + it.rows
+        for k, n in contributed.items():
+            self._skipped[k] = 0
+            self.fair_rows[k] = self.fair_rows.get(k, 0) + n
+            if self._metrics is not None and self.qos is not None:
+                self._metrics.counter(
+                    "qos", f"fair_rows_{k[0]}_{k[1]}").inc(n)
+        for k in keys:
+            if k not in contributed and self._queues.get(k):
+                self._skipped[k] = self._skipped.get(k, 0) + 1
+        if self._pending_rows == 0:
+            self._force = False
+        return items
+
+    # ---- device round trip ---------------------------------------------------
+
+    def _dispatch(self, items: List[Submission]) -> None:
+        """Runs on the dispatcher thread. ``engine.dispatch`` may park on
+        the pipeline ring — bounded, and exactly the backpressure the
+        split-phase engine defines. Every path (success, engine failure,
+        evicted engine) funnels into :meth:`_finish`, which owns the
+        single slot decrement."""
+        t0 = time.perf_counter()
+        try:
+            engine = self._engine_ref()
+            if engine is None:
+                raise RuntimeError(
+                    f"engine {self.engine_name!r} was evicted with rows "
+                    "queued")
+            if self._m:
+                for it in items:
+                    self._m["batch_wait"].observe((t0 - it.enq) * 1e3)
+            dispatch = getattr(engine, "dispatch", None)
+            if dispatch is None:
+                # predict-only engines (plain test doubles): serialized.
+                parts = [it.data for it in items]
+                x = parts[0] if len(parts) == 1 else np.concatenate(parts)
+                out = engine.predict(x)
+                self._finish(items, out, None, None, t0,
+                             time.perf_counter())
+                return
+            handle = dispatch([it.data for it in items])
+        except BaseException as e:  # noqa: BLE001 - fail ONLY this batch
+            self._finish(items, None, e, None, t0, time.perf_counter())
+            return
+        t1 = time.perf_counter()
+        if self._m:
+            # Slot wait: time parked on the engine ring (the continuous
+            # analogue of the operator's dispatch-semaphore wait).
+            self._m["disp_wait"].observe((t1 - t0) * 1e3)
+        handle.future.add_done_callback(
+            lambda f, its=items, h=handle, a=t0, b=t1:
+            self._on_done(its, f, h, a, b))
+
+    def _on_done(self, items: List[Submission], fut: Future, handle,
+                 t_form: float, t_disp: float) -> None:
+        """Engine fetch-thread callback: free the mirrored slot FIRST (the
+        dispatcher can refill while we slice results), then resolve every
+        member future."""
+        exc = fut.exception()
+        out = None if exc is not None else fut.result()
+        self._finish(items, out, exc, handle, t_form, time.perf_counter(),
+                     t_disp)
+
+    def _finish(self, items, out, exc, handle, t_form, t_done,
+                t_disp=None) -> None:
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify_all()
+        rows = sum(it.rows for it in items)
+        t_disp = t_disp if t_disp is not None else t_form
+        if exc is not None:
+            # Exactly-once per source: every member record fails with the
+            # batch's exception and each source replays ITS OWN tuples.
+            for it in items:
+                it.future.set_exception(exc)
+            return
+        padded = rows
+        if handle is not None:
+            padded = int(getattr(handle, "padded", rows) or rows)
+        fill = rows / max(padded, 1)
+        sources = {it.source for it in items}
+        self.batches += 1
+        self.rows_dispatched += rows
+        self._fills.append(fill)
+        self.last_batch = {
+            "rows": rows, "padded": padded, "fill": round(fill, 4),
+            "records": len(items), "sources": sorted(sources)}
+        batch_span = None
+        if self._tracer is not None and self._tracer.active:
+            batch_span = self._trace(items, t_disp, t_done, handle, fill,
+                                     len(sources))
+        if batch_span is not None:
+            for it in items:
+                it.batch_span = batch_span
+        if self._m:
+            self._m["batch_size"].observe(rows)
+            self._m["batch_fill"].observe(fill)
+            self._m["device_ms"].observe((t_done - t_disp) * 1e3)
+            self._m["infer"].inc(rows)
+            self._m["coalesced"].inc(len(sources))
+            timings = getattr(handle, "timings", None) if handle else None
+            if timings:
+                for key, _ in DEVICE_SUBSTAGES:
+                    if key in timings:
+                        self._m["substage"][key].observe(timings[key])
+        if self._flight is not None:
+            self._flight.event(
+                "batch_formed", throttle_s=1.0,
+                component=self._cid or "continuous",
+                size=rows, records=len(items),
+                fill=round(fill, 3), sources=len(sources),
+                device_ms=round((t_done - t_disp) * 1e3, 3),
+                continuous=True)
+        ofs = 0
+        for it in items:
+            n = it.rows
+            it.future.set_result(out[ofs:ofs + n])
+            ofs += n
+
+    def _trace(self, items, t0, t1, handle, fill, n_sources):
+        """Continuous-mode analogue of the operator's ``_trace_batch``:
+        queue_wait per sampled record, one shared device span linked to
+        all members, with batch_fill/sources attrs."""
+        tracer = self._tracer
+        cid = self._cid or "continuous"
+        traced = []
+        for it in items:
+            ctx = self._trace_of(it.payload) if self._trace_of else None
+            if ctx is not None:
+                # Escalated records link back to the span of the tier
+                # that escalated them (link_of), chaining the journey.
+                back = self._link_of(it.payload) if self._link_of else None
+                traced.append((it, ctx, tracer.record(
+                    ctx, "queue_wait", cid, it.enq or t0, t0,
+                    links=(back,) if back else ())))
+        if not traced:
+            return None
+        batch_span = tracer.new_span_id()
+        links = tuple(qid for _, _, qid in traced)
+        attrs = {"batch_size": sum(it.rows for it in items),
+                 "records": len(items), "fill": round(fill, 3),
+                 "sources": n_sources, "continuous": True}
+        timings = getattr(handle, "timings", None) if handle else None
+        if timings:
+            for key, _ in DEVICE_SUBSTAGES:
+                if key in timings:
+                    attrs[key] = round(timings[key], 3)
+        for _, ctx, qid in traced:
+            tracer.record(ctx, self._span_name, cid, t0, t1,
+                          span_id=batch_span, parent_id=qid,
+                          links=links, attrs=attrs)
+        return batch_span
+
+    # ---- introspection -------------------------------------------------------
+
+    def fill_median(self) -> Optional[float]:
+        if not self._fills:
+            return None
+        return float(np.median(list(self._fills)))
+
+    def stats(self) -> dict:
+        """Fairness + fill summary for the qos UI route."""
+        with self._cond:
+            pending = {f"{k[0]}:{k[1]}": sum(s.rows for s in q)
+                       for k, q in self._queues.items() if q}
+        med = self.fill_median()
+        return {
+            "engine": self.engine_name,
+            "capacity": self.capacity,
+            "inflight": self._inflight,
+            "pending_rows": self._pending_rows,
+            "pending_by_key": pending,
+            "batches": self.batches,
+            "rows": self.rows_dispatched,
+            "batch_fill_p50": None if med is None else round(med, 4),
+            "fair_rows": {f"{k[0]}:{k[1]}": v
+                          for k, v in self.fair_rows.items()},
+            "fair_starved": {f"{k[0]}:{k[1]}": v
+                             for k, v in self.fair_starved.items()},
+            "last_batch": self.last_batch,
+        }
+
+
+# ---- per-engine registry ------------------------------------------------------
+
+# One ContinuousBatcher per live engine object: replicas, the serve path,
+# and cascade tiers sharing an engine (via the shared_engine cache) get the
+# SAME queue — that identity is what makes them co-batch. Entries hold the
+# engine weakly (a finalizer closes the queue when the engine is evicted),
+# so the cache's orphan-refcount eviction keeps working.
+_REGISTRY: Dict[int, ContinuousBatcher] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def continuous_for(engine, cfg: BatchConfig,
+                   qos: Optional[QosConfig] = None) -> ContinuousBatcher:
+    """The engine's continuous queue, created on first use. ``cfg``/``qos``
+    apply on creation only (first caller wins) — all sources sharing an
+    engine share one formation policy, like they share its buckets."""
+    key = id(engine)
+    with _REGISTRY_LOCK:
+        cb = _REGISTRY.get(key)
+        if cb is not None and cb._engine_ref() is engine:
+            return cb
+        cb = ContinuousBatcher(engine, cfg, qos)
+        _REGISTRY[key] = cb
+
+        def _drop(k=key):
+            with _REGISTRY_LOCK:
+                dead = _REGISTRY.pop(k, None)
+            if dead is not None:
+                dead.close()
+
+        weakref.finalize(engine, _drop)
+        return cb
+
+
+def registry_stats() -> List[dict]:
+    """Stats for every live continuous queue (the qos UI route)."""
+    with _REGISTRY_LOCK:
+        cbs = [cb for cb in _REGISTRY.values()
+               if cb._engine_ref() is not None]
+    return [cb.stats() for cb in cbs]
+
+
+def _reset_registry() -> None:
+    """Test hook: close and drop every queue."""
+    with _REGISTRY_LOCK:
+        cbs = list(_REGISTRY.values())
+        _REGISTRY.clear()
+    for cb in cbs:
+        cb.close()

@@ -313,6 +313,9 @@ class InferenceEngine:
             queue.SimpleQueue()
         self._fetch_thread: Optional[threading.Thread] = None
         self._fetch_thread_lock = threading.Lock()
+        # Dispatch slots visible to the continuous batcher: ring depth when
+        # pipelined, else the single serialized predict slot.
+        self.ring_capacity = max(1, self.pipeline_depth)
 
         params, state = load_or_init(self.model, model_cfg.checkpoint, model_cfg.seed)
         if self.ep > 1:
@@ -849,6 +852,7 @@ class NullEngine:
     def __init__(self, input_shape: Tuple[int, ...], num_classes: int) -> None:
         self.input_shape = tuple(input_shape)
         self.num_classes = int(num_classes)
+        self.ring_capacity = 1
 
     def warmup(self, buckets=None) -> None:  # no device, nothing to compile
         pass

@@ -214,6 +214,12 @@ class InferenceBolt(Bolt):
         self._m_ingest = m.histogram(cid, "ingest_lag_ms")  # append -> bolt
         self._m_batch_wait = m.histogram(cid, "batch_wait_ms")  # in batcher
         self._m_disp_wait = m.histogram(cid, "dispatch_wait_ms")  # sem queue
+        # Fragmentation metrics (both dispatch paths, so the continuous
+        # A/B has a baseline): rows dispatched / padded bucket capacity,
+        # and how many distinct sources each dispatched batch coalesced
+        # (always 1 on the per-task deadline path).
+        self._m_fill = m.histogram(cid, "batch_fill")
+        self._m_coalesced = m.counter(cid, "coalesced_sources")
         # Split-phase pipeline substages (engine dispatch/fetch timings):
         # together they decompose device_ms, so --latency-breakdown keeps
         # them OUT of the stage sum (device_ms already counts that time).
@@ -248,6 +254,44 @@ class InferenceBolt(Bolt):
                         rt.engine.on_compile = hook
                     except AttributeError:
                         pass  # slotted test double
+        # Continuous batching (BatchGen, ROADMAP item 3): batch formation
+        # moves OFF this task into the engine's shared slot-level queue —
+        # every replica, the serve cross-batcher, and cascade residues
+        # co-batch there. The per-task batchers above stay as admission
+        # shims (shed/lane classification still happens here); they just
+        # never accumulate.
+        self._continuous = bool(getattr(self.batch_cfg, "continuous", False))
+        self._cbs = {}
+        if self._continuous:
+            from storm_tpu.infer.continuous import continuous_for
+
+            trace_of = lambda p: self._anchor_of(p).trace  # noqa: E731
+            link_of = (  # noqa: E731
+                lambda p: p.link_span if isinstance(p, Escalated) else None)
+            if self._router is not None:
+                for rt in self._router.tiers:
+                    tcb = continuous_for(rt.engine, self.batch_cfg, self.qos)
+                    tcb.bind(m, cid, tracer=self._tracer,
+                             flight=self._flight, trace_of=trace_of,
+                             link_of=link_of,
+                             span_name=f"cascade_tier{rt.index}")
+                    self._cbs[rt.index] = tcb
+            else:
+                cb = continuous_for(self.engine, self.batch_cfg, self.qos)
+                cb.bind(m, cid, tracer=self._tracer, flight=self._flight,
+                        trace_of=trace_of, link_of=link_of,
+                        span_name="device_execute")
+                self._cbs[None] = cb
+            # Per-task backpressure: the dispatch semaphore bounded
+            # BATCHES in flight; here the queue owns batching, so the
+            # task bounds its outstanding ROWS at the equivalent
+            # max_inflight * max_batch.
+            self._cb_cap = (max(1, self.batch_cfg.max_inflight)
+                            * max(1, self.batch_cfg.max_batch))
+            self._cb_rows = 0
+            self._cb_room = asyncio.Event()
+            self._cb_room.set()
+            self._cb_source = f"{cid}#{context.task_index}"
 
     # ---- ingest --------------------------------------------------------------
 
@@ -385,6 +429,9 @@ class InferenceBolt(Bolt):
         router is active, the plain operator batcher otherwise) and drain
         every batch that comes due — add returns at most one batch per
         call; a full one must not sit until the deadline."""
+        if getattr(self, "_continuous", False):
+            await self._submit_record(item, data, ts, lane, entry)
+            return
         if entry is None:
             b, tier = self.batcher, None
         else:
@@ -412,6 +459,84 @@ class InferenceBolt(Bolt):
             await self._ingest(handle, inst.data, t.root_ts or None, lane,
                                entry)
         self._kick_flush()
+
+    # ---- continuous batching path --------------------------------------------
+
+    async def _submit_record(self, item, data, ts, lane, entry) -> None:
+        """Hand one record to its tier's shared continuous queue and
+        complete it from a per-record task. Backpressure is row-counted
+        per task (``max_inflight * max_batch`` outstanding rows — the
+        row-equivalent of the dispatch semaphore, which bounded whole
+        batches); the engine's pipeline ring stays the device-side
+        bound."""
+        n = int(data.shape[0])
+        while self._cb_rows >= self._cb_cap:
+            self._cb_room.clear()
+            await self._cb_room.wait()
+        self._cb_rows += n
+        tenant = (self._anchor_of(item).get("qos_tenant", None)
+                  if self.qos is not None else None)
+        sub = self._cbs[entry].submit(
+            data, payload=item, ts=ts, lane=lane, tenant=tenant,
+            source=self._cb_source)
+        task = asyncio.get_running_loop().create_task(
+            self._finish_record(sub, entry, n))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    async def _finish_record(self, sub, tier, n_rows: int) -> None:
+        """Await one submission through as many cascade tiers as it
+        needs, then emit + complete — the continuous analogue of
+        ``_run_batch``'s emit/escalate block at record granularity.
+        A queue/device failure at ANY tier fails the ORIGINAL tuple
+        (``_complete`` unwraps ``Escalated``), so the record replays
+        from tier 0 — exactly-once semantics identical to the batch
+        path."""
+        item = sub.payload
+        try:
+            while True:
+                out = await asyncio.wrap_future(sub.future)
+                if tier is None:
+                    preds = out
+                    break
+                level = (int(self._shed_gauge.value)
+                         if self.qos is not None else 0)
+                merged, residue, info = self._router.decide_item(
+                    item, sub.data, out, sub.lane, tier, level, ts=sub.ts)
+                if residue is None:
+                    preds = merged
+                    break
+                wrapper = residue.payload
+                # Chain the trace: the next tier's queue_wait span links
+                # back to the span of the batch that escalated this row.
+                wrapper.link_span = sub.batch_span
+                if self._flight is not None:
+                    self._flight.event(
+                        "cascade_escalation", throttle_s=1.0,
+                        component=self.context.component_id,
+                        tier=tier, model=self._router.tiers[tier].name,
+                        escalation_rate=round(
+                            self._router.escalation_rate(), 4), **info)
+                item = wrapper
+                tier += 1
+                sub = self._cbs[tier].submit(
+                    residue.data, payload=wrapper, ts=residue.ts,
+                    lane=residue.lane, tenant=sub.tenant,
+                    source=self._cb_source)
+            anchor = self._anchor_of(item)
+            with span(self.context.metrics, self.context.component_id,
+                      "encode"):
+                msg = encode_predictions(preds)
+            await self.collector.emit(
+                Values([msg, *self._extras(anchor)]), anchors=[anchor])
+            self._complete(item, True)
+        except Exception as e:
+            self.collector.report_error(e)
+            self._complete(item, False)
+        finally:
+            self._cb_rows -= n_rows
+            if self._cb_rows < self._cb_cap:
+                self._cb_room.set()
 
     async def _dead_letter(self, t: Tuple, payload: str, error: str) -> None:
         """Poison input: route to the dead-letter stream and ack (replaying
@@ -504,7 +629,8 @@ class InferenceBolt(Bolt):
         task.add_done_callback(self._inflight.discard)
 
     def _trace_batch(self, batch: Batch, t0: float, t1: float,
-                     timings=None, tier: Optional[int] = None):
+                     timings=None, tier: Optional[int] = None,
+                     fill: Optional[float] = None):
         """Span bookkeeping for one device round trip: a ``queue_wait``
         span per SAMPLED record (batcher entry -> device start) and ONE
         shared device span — ``device_execute``, or ``cascade_tier{i}``
@@ -534,6 +660,8 @@ class InferenceBolt(Bolt):
         links = tuple(qid for _, qid in traced)
         name = "device_execute" if tier is None else f"cascade_tier{tier}"
         attrs = {"batch_size": batch.size, "records": len(batch.items)}
+        if fill is not None:
+            attrs["fill"] = round(fill, 3)
         if tier is not None:
             attrs["tier"] = tier
             attrs["model"] = self._router.tiers[tier].name
@@ -557,6 +685,7 @@ class InferenceBolt(Bolt):
             dispatch = getattr(engine, "dispatch", None)
             t0 = time.perf_counter()
             timings = None
+            handle = None
             if dispatch is not None:
                 # Split-phase path: dispatch (stage into the engine's
                 # pooled buffer + H2D + async launch) runs on a worker
@@ -583,9 +712,19 @@ class InferenceBolt(Bolt):
                         self._m_substage[key].observe(timings[key])
             self._m_batch.observe(batch.size)
             self._m_infer.inc(batch.size)
+            # Fragmentation: rows / padded bucket capacity. Per-task
+            # deadline batches are single-source by construction, so the
+            # coalesced counter advances by 1 — the baseline the
+            # continuous queue's multi-source batches compare against.
+            padded = (int(getattr(handle, "padded", 0) or 0)
+                      or self.batch_cfg.bucket_for(batch.size))
+            fill = batch.size / max(padded, 1)
+            self._m_fill.observe(fill)
+            self._m_coalesced.inc()
             batch_span = None
             if self._tracer is not None and self._tracer.active:
-                batch_span = self._trace_batch(batch, t0, t1, timings, tier)
+                batch_span = self._trace_batch(batch, t0, t1, timings,
+                                               tier, fill)
             if self._flight is not None:
                 # Sampled (throttled) batch-formed events: enough to see
                 # batch-size/device-time behavior in a post-mortem without
@@ -594,6 +733,7 @@ class InferenceBolt(Bolt):
                     "batch_formed", throttle_s=1.0,
                     component=self.context.component_id,
                     size=batch.size, records=len(batch.items),
+                    fill=round(fill, 3), sources=1,
                     device_ms=round((t1 - t0) * 1e3, 3),
                     **({} if rt is None else {"tier": tier,
                                               "model": rt.name}))
@@ -700,6 +840,16 @@ class InferenceBolt(Bolt):
         batches, so a graceful stop never strands undecoded acks. Loops
         because finishing a cascade tier's batches can re-fill a LATER
         tier's batcher with escalated residue."""
+        if getattr(self, "_continuous", False):
+            # Force the shared queues to dispatch and wait for this
+            # task's per-record completions. Re-flush on a short period:
+            # a record escalating mid-drain enqueues into a LATER tier's
+            # queue after its flush already drained.
+            while self._inflight:
+                for cb in set(self._cbs.values()):
+                    cb.flush()
+                await asyncio.wait(list(self._inflight), timeout=0.05)
+            return
         while True:
             for tier, b in self._sources:
                 batch = b.take_all()

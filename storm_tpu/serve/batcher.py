@@ -13,6 +13,12 @@ next arrival starts a new window — windows pipeline behind the device queue.
 
 This is the server-side analogue of the reference's missing batching
 (one ``session.run`` per tuple, InferenceBolt.java:80-86, SURVEY.md §3.3).
+
+With ``continuous=True`` the leader-window machinery is bypassed entirely:
+each RPC submits its rows straight into the engine's shared continuous
+queue (:mod:`storm_tpu.infer.continuous`), where they coalesce with
+topology replicas and cascade residues — serve and streaming traffic
+co-batch on the same device slot schedule.
 """
 
 from __future__ import annotations
@@ -36,16 +42,38 @@ class _Req:
 
 class CrossCallerBatcher:
     def __init__(self, engine, window_ms: float = 2.0,
-                 max_batch: Optional[int] = None) -> None:
+                 max_batch: Optional[int] = None,
+                 continuous: bool = False, batch_cfg=None,
+                 qos=None) -> None:
         self.engine = engine
         self.window_s = window_ms / 1000.0
-        self.max_batch = max_batch or engine.batch_cfg.max_batch
+        cfg = batch_cfg or getattr(engine, "batch_cfg", None)
+        self.max_batch = max_batch or getattr(cfg, "max_batch", None) or 8
         self._lock = threading.Lock()
         self._pending: List[_Req] = []
         self._leader_active = False
         self.dispatches = 0  # instrumentation: device dispatch count
+        self._cb = None
+        if continuous:
+            from storm_tpu.infer.continuous import continuous_for
 
-    def predict(self, x: np.ndarray) -> np.ndarray:
+            if cfg is None:
+                from storm_tpu.config import BatchConfig
+
+                cfg = BatchConfig()
+            self._cb = continuous_for(engine, cfg, qos)
+
+    def predict(self, x: np.ndarray, lane: Optional[str] = None,
+                tenant: Optional[str] = None) -> np.ndarray:
+        if self._cb is not None:
+            # Continuous path: the shared per-engine queue owns window
+            # timing and coalescing (across RPCs AND topology sources);
+            # this thread just blocks on its own row slice.
+            sub = self._cb.submit(x, lane=lane, tenant=tenant,
+                                  source="serve")
+            out = sub.future.result()
+            self.dispatches = self._cb.batches
+            return out
         req = _Req(x)
         with self._lock:
             self._pending.append(req)

@@ -74,8 +74,17 @@ class InferenceWorker:
         # cross_batch_ms > 0: coalesce concurrent Predict RPCs from different
         # callers into one device dispatch (serve/batcher.py). Off by default
         # — single-caller deployments shouldn't pay the window latency.
+        # batch.continuous routes RPCs into the engine's shared continuous
+        # queue instead, where they co-batch with topology traffic on the
+        # same slot schedule (no leader window at all).
         self._batcher = None
-        if cross_batch_ms > 0:
+        bc = batch or BatchConfig()
+        if getattr(bc, "continuous", False):
+            from storm_tpu.serve.batcher import CrossCallerBatcher
+
+            self._batcher = CrossCallerBatcher(
+                self.engine, continuous=True, batch_cfg=bc)
+        elif cross_batch_ms > 0:
             from storm_tpu.serve.batcher import CrossCallerBatcher
 
             self._batcher = CrossCallerBatcher(self.engine, window_ms=cross_batch_ms)

@@ -42,6 +42,27 @@ def test_cited_artifacts_exist(doc):
           "is committed)")
 
 
+def test_contbatch_artifact_gates():
+    """BENCH_CONTBATCH_r10.json is the evidence the round-10 docs cite
+    for striking the parallelism-inversion caveat — pin the two claims
+    the docs make to fields the artifact actually carries: 8 bolts >=
+    1 bolt with continuous batching on, and continuous batch_fill p50
+    strictly above the deadline baseline at the SAME paced offered
+    rate (both paced cells valid, i.e. no backlog abort)."""
+    import json
+
+    art = json.loads((REPO / "BENCH_CONTBATCH_r10.json").read_text())
+    assert art["metric"] == "parallelism_compare_lenet5"
+    assert art["continuous8_ge_continuous1"] is True
+    assert art["continuous_fill_gt_deadline"] is True
+    paced = art["batch_fill_paced"]
+    assert paced["deadline"]["offered_msg_s"] == \
+        paced["continuous"]["offered_msg_s"]
+    assert all(paced[m]["valid"] for m in ("deadline", "continuous"))
+    assert art["capture_session"].startswith("cap-")
+    assert art["code_version"]
+
+
 def test_citation_regex_sees_the_docs():
     """Guard the guard: if the artifact naming convention changes and the
     regex goes blind, this fails instead of the main test silently
