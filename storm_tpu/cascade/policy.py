@@ -54,6 +54,9 @@ class CascadeConfig:
     enabled: bool = False
     # Model registry names, cheapest tier first. A record enters at tier 0
     # and escalates until a tier accepts it; the last tier always accepts.
+    # The ordering claim is auditable at runtime: the /cascade UI route's
+    # per-tier ``cost`` rows carry each tier's LIVE measured ms/row from
+    # the cost profiler (storm_tpu/obs/profile.py).
     tiers: tuple = ()
     # Per-tier checkpoint dirs aligned with ``tiers``. "" = inherit the
     # operator's model checkpoint when the tier name matches its model,

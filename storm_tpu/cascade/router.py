@@ -331,9 +331,18 @@ class CascadeRouter:
 
     def inventory(self) -> list:
         """Per-tier engine attribution for the UI ``cascade`` route: which
-        model serves each tier, its gate, and the HBM its params occupy —
-        so a multi-engine bolt reads as N sized tiers, not one opaque
-        blob (ISSUE 5 satellite)."""
+        model serves each tier, its gate, the HBM its params occupy, and
+        the tier's LIVE measured cost — so a multi-engine bolt reads as N
+        sized tiers, not one opaque blob (ISSUE 5 satellite).
+
+        ``cost`` is the cost profiler's per-row device cost for the
+        tier's engine (storm_tpu/obs/profile.py), measured from this
+        process's own traffic — the cheapest-first tier ordering the
+        cascade config asserts is auditable here as numbers, not a
+        doc note. None until the tier has served a batch."""
+        from storm_tpu.obs.profile import profile_store
+
+        store = profile_store()
         rows = []
         for tier in self.tiers:
             eng = tier.engine
@@ -345,6 +354,8 @@ class CascadeRouter:
                               else self.cfg.thresholds[tier.index]),
                 "pending_records": len(tier.batcher)
                 if tier.batcher is not None else 0,
+                "cost": store.cost_of(
+                    getattr(eng, "profile_key", tier.name)),
             }
             for attr in ("param_bytes", "param_bytes_per_device"):
                 fn = getattr(eng, attr, None)

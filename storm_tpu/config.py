@@ -489,6 +489,54 @@ class TracingConfig:
 
 
 @dataclass
+class ObsConfig:
+    """Continuous profiling & SLO-burn observatory (storm_tpu/obs/).
+
+    The per-(engine, bucket) cost profiler itself is always-on and
+    near-free (one dict update per device batch — see
+    BENCH_OBS_OVERHEAD_r11.json); ``enabled`` gates the *control loop*:
+    the Observatory task that steps the burn tracker, publishes occupancy
+    gauges, and runs the regression sentinel. The burn tracker needs
+    ``tracing.slo_ms`` set — without it the sink never counts breaches
+    and burn stays 0.
+    """
+
+    enabled: bool = False
+    # Observatory step cadence (burn tracker + occupancy gauges).
+    interval_s: float = 1.0
+    # SLO objective: fraction of delivered records inside tracing.slo_ms.
+    # The error budget is 1 - slo_objective.
+    slo_objective: float = 0.99
+    # Multi-window burn: both windows must exceed burn_threshold to trip
+    # (fast reacts, slow de-flaps). Burn 1.0 = spending budget exactly.
+    burn_fast_window_s: float = 60.0
+    burn_slow_window_s: float = 600.0
+    burn_threshold: float = 1.0
+    # Regression sentinel: compare live stage costs against this
+    # PROFILE_*.json snapshot ("" = sentinel off); flag a (engine,
+    # bucket, stage) cell when live mean > regression_factor x baseline,
+    # once it has at least min_samples live observations.
+    baseline_path: str = ""
+    regression_factor: float = 1.5
+    sentinel_interval_s: float = 10.0
+    min_samples: int = 20
+
+    def __post_init__(self) -> None:
+        if self.interval_s <= 0 or self.sentinel_interval_s <= 0:
+            raise ValueError("obs intervals must be > 0")
+        if not 0.0 < float(self.slo_objective) < 1.0:
+            raise ValueError(
+                f"obs.slo_objective must be in (0, 1), got "
+                f"{self.slo_objective!r}")
+        if (self.burn_fast_window_s <= 0
+                or self.burn_slow_window_s < self.burn_fast_window_s):
+            raise ValueError(
+                "need 0 < obs.burn_fast_window_s <= obs.burn_slow_window_s")
+        if self.regression_factor <= 1.0:
+            raise ValueError("obs.regression_factor must be > 1")
+
+
+@dataclass
 class QosConfig:
     """Admission control & QoS: per-tenant token-bucket rate limiting at the
     spout edge, weighted priority lanes with earliest-deadline-first batch
@@ -638,6 +686,9 @@ class Config:
     control: ControlConfig = field(default_factory=ControlConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     qos: QosConfig = field(default_factory=QosConfig)
+    # Continuous profiling & SLO-burn observatory (storm_tpu/obs/): cost
+    # curves the planner consumes + burn-rate shed signal. TOML: [obs].
+    obs: ObsConfig = field(default_factory=ObsConfig)
     # Confidence-gated model cascade (storm_tpu/cascade/): tiered serving
     # where easy records accept at a cheap tier and only the hard residue
     # escalates to the flagship. TOML: [cascade].

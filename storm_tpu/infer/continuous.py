@@ -497,12 +497,20 @@ class ContinuousBatcher:
         with self._cond:
             pending = {f"{k[0]}:{k[1]}": sum(s.rows for s in q)
                        for k, q in self._queues.items() if q}
+            # Queue-age occupancy signal for the observatory: how long the
+            # oldest queued record has been waiting (0 when idle).
+            oldest_ms = 0.0
+            if any(q for q in self._queues.values()):
+                oldest_ms = max(
+                    0.0,
+                    (time.perf_counter() - self._oldest_enq_locked()) * 1e3)
         med = self.fill_median()
         return {
             "engine": self.engine_name,
             "capacity": self.capacity,
             "inflight": self._inflight,
             "pending_rows": self._pending_rows,
+            "oldest_ms": round(oldest_ms, 3),
             "pending_by_key": pending,
             "batches": self.batches,
             "rows": self.rows_dispatched,

@@ -139,6 +139,16 @@ class StagingPool:
             sem = self._sems[key]
         sem.release()
 
+    def stats(self) -> Dict[str, int]:
+        """Utilization snapshot for the observatory's occupancy gauges:
+        buffers ever allocated, currently free, and (the difference) held
+        by in-flight batches."""
+        with self._lock:
+            free = sum(len(v) for v in self._free.values())
+            return {"allocated": self.allocated, "free": free,
+                    "in_use": max(0, self.allocated - free),
+                    "limit": self.limit}
+
 
 class InflightBatch:
     """Handle for one batch inside the split-phase pipeline.
@@ -154,14 +164,17 @@ class InflightBatch:
     read them only after ``future`` resolves.
     """
 
-    __slots__ = ("future", "n", "padded", "timings", "_out", "_buf",
-                 "_t_launched")
+    __slots__ = ("future", "n", "padded", "timings", "profile_key", "_out",
+                 "_buf", "_t_launched")
 
     def __init__(self, n: int, padded: int) -> None:
         self.future: Future = Future()
         self.n = n
         self.padded = padded
         self.timings: Dict[str, float] = {}
+        # Cost-profile attribution: which engine's curve this batch feeds
+        # (set by dispatch; None = don't profile, e.g. test doubles).
+        self.profile_key: Optional[str] = None
         self._out = None  # device array, dropped after fetch
         self._buf = None  # staging buffer, recycled after fetch
         self._t_launched = 0.0
@@ -191,6 +204,17 @@ def _fetch_loop(fetch_q: "queue.SimpleQueue", ring: threading.Semaphore,
             handle.timings["d2h_ms"] = (t2 - t1) * 1e3
             handle._out = None
             handle.future.set_result(res[:handle.n])
+            # Cost profiler (storm_tpu/obs/profile.py): per-(engine,
+            # bucket) curves fed right where all three phase timings are
+            # finally known. One sink check per BATCH; must never fail
+            # (or even slow) a batch.
+            sink = _profile_sink
+            if sink is not None and handle.profile_key is not None:
+                try:
+                    sink.record_batch(handle.profile_key, handle.padded,
+                                      handle.n, handle.timings)
+                except Exception:
+                    pass
         except BaseException as e:  # noqa: BLE001 - fail ONLY this batch
             handle._out = None
             handle.future.set_exception(e)
@@ -199,6 +223,33 @@ def _fetch_loop(fetch_q: "queue.SimpleQueue", ring: threading.Semaphore,
             if buf is not None:
                 staging.release(buf)
             ring.release()
+
+
+# ---- cost-profile sink (storm_tpu/obs/profile.py) ----------------------------
+
+# Process-wide observer for completed batches + cold compiles, same spirit
+# as the per-engine ``on_compile`` hook but installed once for every
+# engine (the ProfileStore is process-scoped, like the engine cache).
+# None = profiling off; the hot path pays one global read per batch.
+_profile_sink = None
+
+
+def set_profile_sink(sink) -> None:
+    """Install (or, with None, remove) the process profile sink. ``sink``
+    needs ``record_batch(key, padded, rows, timings)`` and
+    ``record_compile(key, padded, ms)`` — see
+    :class:`storm_tpu.obs.profile.ProfileStore`."""
+    global _profile_sink
+    _profile_sink = sink
+
+
+def _report_compile(key: str, padded: int, ms: float) -> None:
+    sink = _profile_sink
+    if sink is not None:
+        try:
+            sink.record_compile(key, padded, ms)
+        except Exception:
+            pass  # an observability hook must never fail a batch
 
 
 _COMPILE_CACHE_DIR: Optional[str] = None
@@ -459,6 +510,26 @@ class InferenceEngine:
         # the first time a bucket shape executes (= XLA compile on the hot
         # path). The inference operator wires it to the flight recorder.
         self.on_compile = None
+        # Cost-profile identity: which curve this engine's batches feed in
+        # the process ProfileStore. Checkpoint-qualified so cascade tiers /
+        # swap variants sharing a registry name keep separate curves.
+        ckpt = getattr(model_cfg, "checkpoint", None)
+        self.profile_key = (f"{model_cfg.name}@{ckpt}" if ckpt
+                            else model_cfg.name)
+
+    # ---- occupancy telemetry (storm_tpu/obs) ---------------------------------
+
+    @property
+    def ring_inflight(self) -> int:
+        """Pipeline-ring slots currently occupied by in-flight batches.
+        Reads the semaphore's internal counter — telemetry only (the
+        value can be a step stale; the ring itself stays the bound)."""
+        if self._ring is None:
+            return 0
+        return max(0, self.pipeline_depth - self._ring._value)
+
+    def staging_stats(self) -> Dict[str, int]:
+        return self._staging.stats()
 
     # ---- memory accounting ---------------------------------------------------
 
@@ -544,6 +615,7 @@ class InferenceEngine:
         """
         n = sum(int(p.shape[0]) for p in parts)
         handle = InflightBatch(n, self.pad_batch(n))
+        handle.profile_key = self.profile_key
         if self._ring is None:
             x = parts[0] if len(parts) == 1 else np.concatenate(parts)
             try:
@@ -621,11 +693,13 @@ class InferenceEngine:
                 out = self._fwd(self.params, self.state, xd)
         t1 = time.perf_counter()
         self.compiled_batches.add(padded)
-        if cold and self.on_compile is not None:
-            try:
-                self.on_compile(padded, (t1 - t0) * 1e3)
-            except Exception:
-                pass  # an observability hook must never fail a batch
+        if cold:
+            _report_compile(self.profile_key, padded, (t1 - t0) * 1e3)
+            if self.on_compile is not None:
+                try:
+                    self.on_compile(padded, (t1 - t0) * 1e3)
+                except Exception:
+                    pass  # an observability hook must never fail a batch
         handle._out = out
         handle._t_launched = t1
         # Staging + H2D + async launch (plus XLA compile when cold — the
@@ -689,12 +763,14 @@ class InferenceEngine:
                 out = self._fwd(self.params, self.state, xd)
                 gathered = self._gather_locked(out)
         self.compiled_batches.add(padded)
-        if cold and self.on_compile is not None:
-            try:
-                self.on_compile(padded,
-                                (time.perf_counter() - t_compile) * 1e3)
-            except Exception:
-                pass  # an observability hook must never fail a batch
+        if cold:
+            ms = (time.perf_counter() - t_compile) * 1e3
+            _report_compile(self.profile_key, padded, ms)
+            if self.on_compile is not None:
+                try:
+                    self.on_compile(padded, ms)
+                except Exception:
+                    pass  # an observability hook must never fail a batch
         if gathered is None:
             # single-process: the host fetch happens OUTSIDE the lock so
             # one batch's device->host RTT doesn't serialize the next
@@ -982,6 +1058,14 @@ def _evict_to_budget_locked(keep: tuple) -> None:
             "(budget %.1fMB)",
             e.model_cfg.name, per_dev / 1e6, limit / 1e6)
         del e  # drop the last reference -> HBM reclaimed
+
+
+def live_engines() -> list:
+    """Strong refs to every cached engine (observatory occupancy sweep:
+    ring/staging state lives on the engine objects, not in
+    :func:`engine_inventory`'s attribution rows)."""
+    with _ENGINES_LOCK:
+        return list(_ENGINES.values())
 
 
 def engine_inventory() -> dict:

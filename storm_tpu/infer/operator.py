@@ -117,6 +117,9 @@ class InferenceBolt(Bolt):
         process-level engine cache makes repeat calls cheap. An engine
         injected at construction (the NullEngine bench path) is kept, not
         replaced — same contract as prepare()."""
+        from storm_tpu.obs.profile import ensure_installed
+
+        ensure_installed()  # before the cold compiles, as in prepare()
         self._engine = self._engine or shared_engine(
             self.model_cfg, self.sharding_cfg, self.batch_cfg)
         if self._warmup:
@@ -154,6 +157,13 @@ class InferenceBolt(Bolt):
 
     def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
         super().prepare(context, collector)
+        # Cost profiler (storm_tpu/obs): point the engine layer's profile
+        # sink at the process ProfileStore BEFORE any engine builds or
+        # warms up, so warmup's cold compiles land in the per-shape
+        # compile table. Idempotent, near-free per batch.
+        from storm_tpu.obs.profile import ensure_installed
+
+        ensure_installed()
         # Shared across operator tasks: params live once in HBM; the mesh is
         # the parallelism (vs. the reference's per-bolt model replica).
         self.engine = self._engine or shared_engine(

@@ -246,6 +246,17 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
                 rt, ShedPolicy.from_qos(cfg.qos, infer_id, sink_id)).start()
             for infer_id, sink_id in pairs
         ]
+    observatory = None
+    if cfg.obs.enabled and not topology_file:
+        from storm_tpu.obs import Observatory
+
+        # Burn is computed over ALL sink components (one per pipeline);
+        # the trip feeds every shedder as an extra hot signal.
+        observatory = Observatory(
+            rt, cfg.obs,
+            sink_components=tuple(sink_id for _, sink_id in pairs)).start()
+        for shedder in shedders:
+            shedder.burn = observatory.burn
     scalers = []
     if autoscale_target_ms > 0:
         from storm_tpu.runtime.autoscale import (
@@ -282,6 +293,7 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
     print(f"topology {name!r} running "
           f"(model={desc}, broker={cfg.broker.kind}"
           f"{', qos' if shedders else ''}"
+          f"{', obs' if observatory else ''}"
           f"{', autoscaling' if scalers else ''}"
           f"{f', ui http://127.0.0.1:{ui.port}' if ui else ''})",
           file=sys.stderr)
@@ -299,6 +311,8 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
         await ui.stop()
     for scaler in scalers:
         await scaler.stop()
+    if observatory is not None:
+        await observatory.stop()
     for shedder in shedders:
         await shedder.stop()
     await rt.deactivate()
@@ -479,6 +493,72 @@ def _traces(args) -> int:
     return 0
 
 
+def _profile_cmd(args) -> int:
+    """Dump the live cost model (per-engine per-bucket stage curves,
+    compile costs, SLO burn, occupancy) from a running topology's UI
+    endpoint (storm_tpu profile <topology>) — the queryable face of
+    storm_tpu/obs, mirroring the traces/flight CLI."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from storm_tpu.config import env_control_token
+
+    base = args.url.rstrip("/")
+    topo = urllib.parse.quote(args.topology, safe="")
+    req = urllib.request.Request(f"{base}/api/v1/topology/{topo}/profile")
+    token = args.token or env_control_token()
+    if token:  # read route is open; header is harmless if unneeded
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        print(e.read().decode("utf-8", "replace"), file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    engines = out.get("profile", {}).get("engines", {})
+    if not engines:
+        print("no profiled batches yet (profiler records on dispatch; "
+              "send traffic first)")
+    for key, eng in engines.items():
+        print(f"engine {key}")
+        for bucket, row in eng.get("buckets", {}).items():
+            st = row.get("stages", {})
+            dev = st.get("device_ms", {})
+            parts = [f"  bucket {bucket:>6}: batches={row['batches']:<6}"
+                     f" rows={row['rows']:<8}"
+                     f" device p50={dev.get('p50')}ms p95={dev.get('p95')}ms"
+                     f" ms/row={row.get('ms_per_row')}"
+                     f" thr={row.get('throughput_rows_s')} rows/s"]
+            print("".join(parts))
+        for shape, c in eng.get("compiles", {}).items():
+            print(f"  compile bucket {shape}: n={c['count']} "
+                  f"last={round(c['last_ms'], 1)}ms")
+    slo = out.get("slo")
+    if slo:
+        print(f"slo: fast_burn={slo.get('fast_burn')} "
+              f"slow_burn={slo.get('slow_burn')} "
+              f"tripped={slo.get('tripped')} trips={slo.get('trips')}")
+    for row in out.get("occupancy", []) or []:
+        print(f"occupancy {row['engine']}: "
+              f"ring {row['ring_inflight']}/{row['ring_capacity']} "
+              f"staging {row['staging_in_use']}/{row['staging_allocated']} "
+              f"queue depth={row['queue_depth']} "
+              f"oldest={row['queue_oldest_ms']}ms")
+    regs = out.get("regressions") or []
+    for r in regs:
+        print(f"REGRESSION {r['engine']} bucket {r['bucket']} {r['stage']}: "
+              f"{r['live_ms']}ms vs baseline {r['baseline_ms']}ms "
+              f"(x{r['ratio']})")
+    return 0
+
+
 def main(argv=None) -> int:
     setup_logging()
     ap = argparse.ArgumentParser(prog="storm_tpu")
@@ -632,6 +712,21 @@ def main(argv=None) -> int:
     tracesp.add_argument("--json", action="store_true",
                          help="raw JSON instead of the rendered view")
 
+    profp = sub.add_parser(
+        "profile",
+        help="dump the live cost model (per-engine/bucket stage curves, "
+             "compile costs, SLO burn, occupancy) from a running "
+             "topology's UI endpoint; enable [obs] on the daemon for "
+             "burn/occupancy state")
+    profp.add_argument("topology")
+    profp.add_argument("--url", default="http://127.0.0.1:8080",
+                       help="base URL of the daemon's --ui-port server")
+    profp.add_argument("--token", default=None,
+                       help="bearer token (default: "
+                            "$STORM_TPU_CONTROL_TOKEN)")
+    profp.add_argument("--json", action="store_true",
+                       help="raw JSON instead of the rendered view")
+
     args = ap.parse_args(argv)
 
     if args.cmd == "run":
@@ -656,6 +751,9 @@ def main(argv=None) -> int:
 
     if args.cmd == "traces":
         return _traces(args)
+
+    if args.cmd == "profile":
+        return _profile_cmd(args)
 
     if args.cmd == "dist-run":
         cfg = _load_config(args)

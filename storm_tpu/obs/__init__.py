@@ -1,0 +1,193 @@
+"""Continuous profiling & SLO-burn observatory (ROADMAP item 1 substrate).
+
+PR 1's observability spine records what *happened* (traces, flight
+events, histograms); this package measures what it *costs* and how fast
+the SLO budget is burning — the two inputs an InferLine-style planner
+needs before it can solve for a config:
+
+- :mod:`storm_tpu.obs.profile` — :class:`ProfileStore`, per-(engine,
+  bucket) stage-cost curves + XLA compile cost per shape, fed by the
+  engine layer's profile sink; snapshot/reload as ``PROFILE_*.json``.
+- :mod:`storm_tpu.obs.slo` — :class:`SloBurnTracker`, multi-window
+  error-budget burn from the sink's delivered/slo_breaches counters;
+  an additional hot signal for the LoadShedController.
+- :class:`Observatory` (here) — the per-topology control loop: steps the
+  burn tracker, publishes occupancy gauges (pipeline-ring slots,
+  continuous-queue depth/oldest-age, StagingPool utilization), and runs
+  the regression sentinel that compares live curves against a loaded
+  baseline, recording ``profile_regression`` flight events on drift.
+
+Everything surfaces through the ``/api/v1/topology/{name}/profile`` UI
+route and the ``storm-tpu profile`` CLI subcommand; config knobs live in
+``ObsConfig`` (``[obs]``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import List, Optional, Sequence
+
+from storm_tpu.obs.profile import (
+    ProfileStore,
+    ensure_installed,
+    profile_store,
+    set_enabled,
+)
+from storm_tpu.obs.slo import SloBurnTracker
+
+log = logging.getLogger("storm_tpu.obs")
+
+__all__ = [
+    "Observatory",
+    "ProfileStore",
+    "SloBurnTracker",
+    "ensure_installed",
+    "profile_store",
+    "set_enabled",
+]
+
+
+class Observatory:
+    """One per topology (``runtime.obs``), same lifecycle shape as the
+    LoadShedController: ``start()`` spins an asyncio step loop,
+    ``step()`` is synchronous and test-drivable."""
+
+    def __init__(self, runtime, cfg=None,
+                 sink_components: Sequence[str] = ("kafka-bolt",),
+                 clock=time.monotonic) -> None:
+        from storm_tpu.config import ObsConfig
+
+        self.rt = runtime
+        self.cfg = cfg or ObsConfig()
+        self.profile = ensure_installed()
+        self.burn = SloBurnTracker(
+            runtime.metrics,
+            components=sink_components,
+            objective=self.cfg.slo_objective,
+            fast_window_s=self.cfg.burn_fast_window_s,
+            slow_window_s=self.cfg.burn_slow_window_s,
+            threshold=self.cfg.burn_threshold,
+            flight=getattr(runtime, "flight", None),
+            clock=clock,
+        )
+        self.clock = clock
+        self.last_regressions: List[dict] = []
+        self._m_regress = runtime.metrics.counter("obs", "profile_regressions")
+        self._last_sentinel = clock()
+        self._task: Optional[asyncio.Task] = None
+        if self.cfg.baseline_path:
+            import json
+
+            try:
+                with open(self.cfg.baseline_path) as fh:
+                    self.profile.load_baseline(json.load(fh))
+                log.info("obs: loaded profile baseline %s",
+                         self.cfg.baseline_path)
+            except (OSError, ValueError) as e:
+                log.warning("obs: cannot load baseline %s: %s",
+                            self.cfg.baseline_path, e)
+        # Expose ourselves so the UI's /profile route can serve burn +
+        # occupancy state (mirrors LoadShedController's runtime.qos).
+        runtime.obs = self
+
+    # ---- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Observatory":
+        self._task = asyncio.get_event_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.cfg.interval_s)
+            try:
+                self.step()
+            except Exception as e:  # pragma: no cover
+                log.warning("obs step failed: %s", e)
+
+    # ---- the control step ----------------------------------------------------
+
+    def step(self) -> None:
+        self.burn.step()
+        self._sample_occupancy()
+        now = self.clock()
+        if now - self._last_sentinel >= self.cfg.sentinel_interval_s:
+            self._last_sentinel = now
+            self.sentinel_check()
+
+    def _sample_occupancy(self) -> None:
+        for row in self.occupancy():
+            key = row["engine"]
+            g = self.rt.metrics.gauge
+            g("obs", f"ring_inflight_{key}").set(row["ring_inflight"])
+            g("obs", f"ring_capacity_{key}").set(row["ring_capacity"])
+            g("obs", f"staging_in_use_{key}").set(row["staging_in_use"])
+            g("obs", f"queue_depth_{key}").set(row["queue_depth"])
+            g("obs", f"queue_oldest_ms_{key}").set(row["queue_oldest_ms"])
+
+    def occupancy(self) -> List[dict]:
+        """Live occupancy per process engine: pipeline-ring slots in use,
+        staging-buffer utilization, and (when continuous batching is on)
+        the engine's queue depth/oldest-age."""
+        from storm_tpu.infer.continuous import registry_stats
+        from storm_tpu.infer.engine import live_engines
+
+        queues = {}
+        for q in registry_stats():
+            queues[q.get("engine")] = q
+        rows = []
+        for e in live_engines():
+            key = getattr(e, "profile_key",
+                          getattr(getattr(e, "model_cfg", None), "name", "?"))
+            staging = (e.staging_stats()
+                       if hasattr(e, "staging_stats") else {})
+            q = queues.get(getattr(
+                getattr(e, "model_cfg", None), "name", None), {})
+            rows.append({
+                "engine": key,
+                "ring_inflight": int(getattr(e, "ring_inflight", 0)),
+                "ring_capacity": int(getattr(e, "ring_capacity", 1)),
+                "staging_in_use": int(staging.get("in_use", 0)),
+                "staging_allocated": int(staging.get("allocated", 0)),
+                "staging_limit": int(staging.get("limit", 0)),
+                "queue_depth": int(q.get("pending_rows", 0)),
+                "queue_oldest_ms": float(q.get("oldest_ms", 0.0)),
+            })
+        return rows
+
+    def sentinel_check(self) -> List[dict]:
+        """Compare live curves to the loaded baseline; record one
+        ``profile_regression`` flight event per drifted (engine, bucket,
+        stage) cell. Returns the regressions found (empty without a
+        baseline)."""
+        regs = self.profile.regressions(
+            factor=self.cfg.regression_factor,
+            min_samples=self.cfg.min_samples)
+        self.last_regressions = regs
+        flight = getattr(self.rt, "flight", None)
+        for r in regs:
+            self._m_regress.inc()
+            if flight is not None:
+                flight.event(
+                    "profile_regression", throttle_s=5.0,
+                    engine=r["engine"], bucket=r["bucket"],
+                    stage=r["stage"], live_ms=r["live_ms"],
+                    baseline_ms=r["baseline_ms"], ratio=r["ratio"])
+        return regs
+
+    def snapshot(self) -> dict:
+        return {
+            "slo": self.burn.snapshot(),
+            "occupancy": self.occupancy(),
+            "regressions": self.last_regressions,
+            "baseline_loaded": self.profile.baseline is not None,
+        }

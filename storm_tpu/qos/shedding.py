@@ -82,6 +82,12 @@ class LoadShedController:
         self._prev_breaches: Optional[int] = None
         self._gauge = runtime.metrics.gauge("qos", "shed_level")
         self._gauge.set(0.0)
+        # Optional SLO burn-rate tracker (storm_tpu/obs/slo.py): when the
+        # observatory attaches one, its fast+slow-window trip is an
+        # additional HOT signal — burn integrates breaches over a window,
+        # so it rises before the raw per-interval breach-rate threshold
+        # does (see BENCH_SLO_BURN_r11.json).
+        self.burn = None
         # Expose ourselves so the UI's /qos route can serve decisions.
         runtime.qos = self
 
@@ -122,10 +128,13 @@ class LoadShedController:
         else:
             delta = max(0, breaches - self._prev_breaches)
         self._prev_breaches = breaches
+        burn = self.burn
         return {
             "inbox_frac": inbox_frac,
             "wait_p95_ms": wait_p95,
             "breach_rate": delta / p.interval_s,
+            "burn_rate": burn.fast_burn if burn is not None else 0.0,
+            "burn_tripped": burn.tripped if burn is not None else False,
         }
 
     def step(self) -> Optional[int]:
@@ -135,10 +144,12 @@ class LoadShedController:
         s = self._signals()
         hot = (s["inbox_frac"] > p.inbox_frac
                or (p.wait_ms > 0 and s["wait_p95_ms"] > p.wait_ms)
-               or s["breach_rate"] > p.breach_rate)
+               or s["breach_rate"] > p.breach_rate
+               or s["burn_tripped"])
         calm = (s["inbox_frac"] < p.inbox_frac / 2
                 and (p.wait_ms <= 0 or s["wait_p95_ms"] < p.wait_ms / 2)
-                and s["breach_rate"] < p.breach_rate / 2)
+                and s["breach_rate"] < p.breach_rate / 2
+                and not s["burn_tripped"])
         if hot:
             self._hot += 1
             self._calm = 0
@@ -176,5 +187,6 @@ class LoadShedController:
                 inbox_frac=round(signals["inbox_frac"], 3),
                 wait_p95_ms=round(signals["wait_p95_ms"], 3),
                 breach_rate=round(signals["breach_rate"], 3),
+                burn_rate=round(signals.get("burn_rate", 0.0), 3),
             )
         return new

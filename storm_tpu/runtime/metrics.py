@@ -39,9 +39,16 @@ class Gauge:
 
 
 class Histogram:
-    """Ring-buffer reservoir; percentiles over the most recent window."""
+    """Ring-buffer reservoir; percentiles over the most recent window.
+
+    Thread-safe for mutation AND reads: device/fetch threads observe while
+    the bench harness resets and the UI thread snapshots — an unguarded
+    ``reset`` racing ``observe`` could leave ``_i >= _n`` torn (negative
+    counts, percentile over stale rows). One plain lock; ``observe`` is a
+    few hundred ns either way, far below any stage this measures."""
 
     def __init__(self, capacity: int = 65536) -> None:
+        self._lock = threading.Lock()
         self._buf = np.zeros(capacity, dtype=np.float64)
         self._n = 0
         self._i = 0
@@ -51,45 +58,89 @@ class Histogram:
         # OpenMetrics exemplar so a dashboard histogram links to the trace
         # that produced the point. None until a sampled record observes.
         self.exemplar = None
+        # Named windowed-rate cursors: key -> (count, sum, t) at last read.
+        self._windows: Dict[str, tuple] = {}
 
     def observe(self, v: float, trace_id: Optional[str] = None) -> None:
-        self._buf[self._i] = v
-        self._i = (self._i + 1) % len(self._buf)
-        self._n = min(self._n + 1, len(self._buf))
-        self.count += 1
-        self.sum += v
-        if trace_id is not None:
-            self.exemplar = (trace_id, v, time.time())
+        with self._lock:
+            self._buf[self._i] = v
+            self._i = (self._i + 1) % len(self._buf)
+            self._n = min(self._n + 1, len(self._buf))
+            self.count += 1
+            self.sum += v
+            if trace_id is not None:
+                self.exemplar = (trace_id, v, time.time())
 
     def percentile(self, q: float) -> float:
-        if self._n == 0:
-            return float("nan")
-        return float(np.percentile(self._buf[: self._n], q))
+        with self._lock:
+            if self._n == 0:
+                return float("nan")
+            window = self._buf[: self._n].copy()
+        return float(np.percentile(window, q))
 
     def reset(self) -> None:
         """Drop the reservoir and counters (bench harness: discard probe /
         calibration traffic so the measured window starts clean)."""
-        self._n = 0
-        self._i = 0
-        self.count = 0
-        self.sum = 0.0
-        self.exemplar = None
+        with self._lock:
+            self._n = 0
+            self._i = 0
+            self.count = 0
+            self.sum = 0.0
+            self.exemplar = None
+            self._windows.clear()
 
     @property
     def mean(self) -> float:
         return self.sum / self.count if self.count else float("nan")
 
+    def window(self, key: str = "default") -> Dict[str, float]:
+        """Count/sum delta since the LAST ``window(key)`` call — the
+        windowed-rate primitive burn/shed/throughput math shares instead
+        of each keeping its own prev-counter bookkeeping. Cursors are
+        named so independent consumers (shed controller, burn tracker,
+        bench sampler) don't steal each other's deltas. First call (or
+        first after ``reset``) reports a zero-length window."""
+        now = time.monotonic()
+        with self._lock:
+            count, total = self.count, self.sum
+            prev = self._windows.get(key)
+            self._windows[key] = (count, total, now)
+        if prev is None:
+            return {"count": 0, "sum": 0.0, "dt_s": 0.0,
+                    "rate_per_s": 0.0, "mean": None}
+        dc = max(0, count - prev[0])
+        ds = max(0.0, total - prev[1])
+        dt = max(0.0, now - prev[2])
+        return {
+            "count": dc,
+            "sum": ds,
+            "dt_s": dt,
+            "rate_per_s": dc / dt if dt > 0 else 0.0,
+            "mean": ds / dc if dc else None,
+        }
+
     def snapshot(self) -> Dict[str, float]:
         def clean(v: float):
             return None if v != v else v  # NaN -> None (JSON-safe)
 
+        with self._lock:
+            count, total = self.count, self.sum
+            window = self._buf[: self._n].copy() if self._n else None
+        if window is None:
+            p50 = p90 = p95 = p99 = mx = float("nan")
+        else:
+            p50, p90, p95, p99 = (
+                float(x) for x in np.percentile(window, (50, 90, 95, 99)))
+            mx = float(window.max())
         return {
-            "count": self.count,
-            "sum": clean(self.sum),  # 0.0 when empty; None only in old snapshots
-            "mean": clean(self.mean),
-            "p50": clean(self.percentile(50)),
-            "p95": clean(self.percentile(95)),
-            "p99": clean(self.percentile(99)),
+            "count": count,
+            "sum": clean(total),  # 0.0 when empty; None only in old snapshots
+            "mean": clean(total / count if count else float("nan")),
+            "p50": clean(p50),
+            "p90": clean(p90),
+            "p95": clean(p95),
+            "p99": clean(p99),
+            "max": clean(mx),
         }
 
 
@@ -199,7 +250,8 @@ def prometheus_text(registries: Dict[str, "MetricsRegistry"]) -> str:
     """Render ``{topology: MetricsRegistry}`` in Prometheus text exposition
     format. Metric *kind* comes from the registry (not value types): counters
     become ``storm_tpu_<name>_total``, gauges ``storm_tpu_<name>``, and
-    histograms a ``_count``/``_sum`` pair plus mean/p50/p95/p99 gauges —
+    histograms a ``_count``/``_sum`` pair plus mean/p50/p90/p95/p99/max
+    gauges —
     enough for a stock Prometheus scrape of the UI server's ``/metrics``
     (including ``rate(_sum)/rate(_count)`` averages).
     """
@@ -236,6 +288,6 @@ def prometheus_text(registries: Dict[str, "MetricsRegistry"]) -> str:
             lines.append(f"{name_of(mname, '_count')}{labels} {h.count}{ex}")
             lines.append(f"{name_of(mname, '_sum')}{labels} {sane(h.sum)}")
             snap = h.snapshot()
-            for q in ("mean", "p50", "p95", "p99"):
+            for q in ("mean", "p50", "p90", "p95", "p99", "max"):
                 lines.append(f"{name_of(mname, '_' + q)}{labels} {sane(snap[q])}")
     return "\n".join(lines) + "\n"

@@ -444,6 +444,25 @@ class UIServer:
                     "topology": rt.name, "bolts": bolts,
                     "cascade": snap.get("cascade", {}),
                     "engines": await asyncio.to_thread(engine_inventory)}
+            if action == "profile" and method == "GET":
+                # Live cost model (storm_tpu/obs): per-(engine, bucket)
+                # stage-cost curves + compile costs from the process
+                # ProfileStore, plus — when an Observatory is attached
+                # (rt.obs) — SLO burn state, occupancy, and the sentinel's
+                # latest regressions. (POST /profile stays the jax
+                # profiler capture action below.)
+                from storm_tpu.obs.profile import profile_store
+
+                out = {"topology": rt.name,
+                       "profile": await asyncio.to_thread(
+                           profile_store().snapshot)}
+                obs = getattr(rt, "obs", None)
+                if obs is not None:
+                    out.update(await asyncio.to_thread(obs.snapshot))
+                else:
+                    snap = await asyncio.to_thread(rt.metrics.snapshot)
+                    out["slo"] = snap.get("slo", {})
+                return 200, out
             if method != "POST":
                 return 405, {"error": "topology actions are POST"}
             return await self._action(rt, action, {**query, **body})

@@ -179,3 +179,45 @@ def test_prometheus_exemplar_tracks_latest_and_reset():
     h.reset()
     text = prometheus_text({"demo": reg})
     assert "# {" not in text
+
+
+def test_prometheus_histogram_p90_and_max_lines():
+    """Round-11 satellite: the rendered quantile set now includes p90 and
+    max (the occupancy/cost dashboards read tail AND ceiling)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("sink", "e2e_latency_ms")
+    for v in range(1, 101):
+        h.observe(float(v))
+    text = prometheus_text({"demo": reg})
+    p90 = next(l for l in text.splitlines()
+               if l.startswith("storm_tpu_e2e_latency_ms_p90"))
+    assert 89.0 <= float(p90.rsplit(" ", 1)[1]) <= 91.0
+    assert 'storm_tpu_e2e_latency_ms_max{topology="demo",component="sink"}' \
+        ' 100.0' in text
+
+
+def test_prometheus_renders_slo_burn_gauges():
+    """The burn tracker's gauges land on /metrics the moment it exists
+    (zeroed at init — a flat 0 series, not a hole, before any step)."""
+    from storm_tpu.obs.slo import SloBurnTracker
+
+    reg = MetricsRegistry()
+    SloBurnTracker(reg, components=("kafka-bolt",))
+    text = prometheus_text({"demo": reg})
+    assert 'storm_tpu_burn_rate{topology="demo",component="slo"} 0.0' in text
+    assert 'storm_tpu_burn_rate_slow{topology="demo",component="slo"} 0.0' \
+        in text
+    assert 'storm_tpu_tripped{topology="demo",component="slo"} 0.0' in text
+
+
+def test_prometheus_renders_obs_occupancy_gauges():
+    """Observatory occupancy gauges are per-engine-suffixed series under
+    the obs component (one scrape shows every live engine's ring)."""
+    reg = MetricsRegistry()
+    reg.gauge("obs", "ring_inflight_lenet5").set(2)
+    reg.gauge("obs", "queue_oldest_ms_lenet5").set(7.5)
+    text = prometheus_text({"demo": reg})
+    assert 'storm_tpu_ring_inflight_lenet5{topology="demo",' \
+        'component="obs"} 2.0' in text
+    assert 'storm_tpu_queue_oldest_ms_lenet5{topology="demo",' \
+        'component="obs"} 7.5' in text
