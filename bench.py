@@ -2719,6 +2719,361 @@ def run_slo_burn(args) -> dict:
     }
 
 
+def run_bottleneck(args) -> dict:
+    """``--bottleneck``: the bottleneck observatory made to name a KNOWN
+    limiter, induced both ways on the same DAG shape:
+
+    - arm ``bn-infer`` (inference-bound): lenet5 behind ONE inference
+      task fed 8-image records by two spouts off an in-process broker —
+      the inference operator's decode + batch + dispatch path is where
+      the wall time goes; the attributor must name ``inference-bolt``.
+    - arm ``bn-spout`` (ingest-bound): NullEngine behind TWO inference
+      tasks, the spout fetching ``fetch_size=1`` against the TCP wire
+      broker — every record pays a full fetch round trip (the classic
+      under-batched-consumer bottleneck), downstream idles; the
+      attributor must name ``kafka-spout``.
+
+    Verdicts are sampled mid-drain through the live
+    ``/api/v1/topology/{name}/bottleneck`` route (majority over the
+    sampled leaders, so one scheduler hiccup cannot flip the gate) —
+    which also proves the route serves while traffic flows. The same
+    capture A/Bs the observatory's cost (Observatory attached at
+    interval_s=0.2 vs detached, interleaved cells over the NullEngine
+    topology, bar <= 2%; the per-tuple executor clock reads are
+    constitutive and present in BOTH arms — the A/B prices the sampling/
+    attribution layer) and probes a 2-worker dist cluster for the
+    controller-merged windowed utilization (``DistCluster.utilization``).
+    """
+    import urllib.request
+
+    from storm_tpu.config import Config, ObsConfig
+    from storm_tpu.connectors import MemoryBroker
+    from storm_tpu.main import (build_null_engine_topology,
+                                build_standard_topology)
+    from storm_tpu.obs import Observatory
+    from storm_tpu.runtime.cluster import LocalCluster
+    from storm_tpu.runtime.ui import UIServer
+
+    obs_cfg = ObsConfig(enabled=True, interval_s=0.2, min_samples=5)
+    tiny_payload = json.dumps({"instances": [[0.5]]}).encode("utf-8")
+
+    def null_cfg() -> Config:
+        cfg = Config()
+        cfg.model.input_shape = (1,)
+        cfg.model.num_classes = 2
+        cfg.batch.max_batch = 64
+        cfg.batch.max_wait_ms = 5.0
+        cfg.batch.buckets = (64,)
+        cfg.topology.spout_parallelism = 1
+        cfg.topology.inference_parallelism = 2
+        cfg.topology.sink_parallelism = 2
+        cfg.topology.message_timeout_s = 300.0
+        cfg.offsets.policy = "earliest"
+        cfg.offsets.max_behind = None
+        cfg.tracing.sample_rate = 0.0
+        cfg.obs = obs_cfg
+        return cfg
+
+    def run_arm(arm: str, cfg: Config, build_fn, backlog: int,
+                window_s: float, expected: str, produce, out_size,
+                broker) -> dict:
+        """Hold a sustained ``backlog`` of unconsumed input for
+        ``window_s`` (host-speed independent — a fixed message count
+        drains before the attributor's first real window on a fast
+        host), polling the live /bottleneck route throughout; then stop
+        producing and drain. The named component is the majority of the
+        sampled leaders."""
+        produced = 0
+        cluster = LocalCluster()
+        leaders = []
+        route = None
+        mid = None
+
+        def top_up():
+            nonlocal produced
+            while produced - out_size() < backlog:
+                produce(produced)
+                produced += 1
+
+        try:
+            top_up()
+            cluster.submit_topology(arm, cfg, build_fn(cfg, broker))
+
+            async def mk():
+                rt = cluster._cluster.runtime(arm)
+                obs = Observatory(rt, obs_cfg,
+                                  sink_components=("kafka-bolt",)).start()
+                ui = await UIServer(cluster._cluster, port=0).start()
+                return obs, ui
+
+            obs, ui = cluster._run(mk())
+            url = (f"http://127.0.0.1:{ui.port}/api/v1/topology/{arm}"
+                   "/bottleneck")
+            # Warmup outside the verdict window: first output = topology
+            # up + first batch through (incl. any XLA compile).
+            warm_deadline = time.time() + 300.0
+            while time.time() < warm_deadline and out_size() == 0:
+                time.sleep(0.05)
+            t_end = time.time() + window_s
+            while time.time() < t_end:
+                top_up()
+                time.sleep(0.15)
+                try:
+                    with urllib.request.urlopen(url, timeout=10) as resp:
+                        route = json.loads(resp.read().decode())
+                except Exception as e:  # noqa: BLE001 - probe is evidence
+                    route = {"error": str(e)}
+                    continue
+                mid = route  # last verdict taken UNDER load
+                leader = (route.get("bottleneck") or {}).get("leader")
+                if leader:
+                    leaders.append(leader)
+            deadline = time.time() + 300.0
+            while time.time() < deadline and out_size() < produced:
+                time.sleep(0.05)
+            drained = out_size() >= produced
+            cluster._run(obs.stop())
+            cluster._run(ui.stop())
+            cluster.kill_topology(arm, wait_secs=2)
+        finally:
+            cluster.shutdown()
+        votes: dict = {}
+        for ld in leaders:
+            votes[ld] = votes.get(ld, 0) + 1
+        named = max(votes, key=votes.get) if votes else None
+        last = (mid or {}).get("bottleneck") or {}
+        log(f"  {arm}: named={named} votes={votes} drained={drained} "
+            f"msgs={produced}")
+        return {
+            "arm": arm,
+            "expected": expected,
+            "named": named,
+            "correct": bool(named == expected and drained),
+            "leader_votes": votes,
+            "messages": produced,
+            "window_s": window_s,
+            "backlog": backlog,
+            "drained": drained,
+            "last_ranked": (last.get("ranked") or [])[:3],
+            "last_critical_path": last.get("critical_path"),
+            "last_utilization": (mid or {}).get("utilization"),
+        }
+
+    # Arm A — inference-bound: lenet5, one inference task, 8-image
+    # records (decode + batch + dispatch cost lands in the operator),
+    # spouts parked at a small pending cap (wait-dominated by design).
+    cfg_a = Config()
+    lenet = CONFIGS["lenet5"]
+    cfg_a.model.name = lenet["model"]
+    cfg_a.model.dtype = "bfloat16"
+    cfg_a.model.input_shape = lenet["input_shape"]
+    cfg_a.model.num_classes = lenet["num_classes"]
+    cfg_a.batch.max_batch = 64
+    cfg_a.batch.max_wait_ms = 10.0
+    cfg_a.batch.buckets = (64,)
+    cfg_a.topology.spout_parallelism = 2
+    cfg_a.topology.inference_parallelism = 1
+    cfg_a.topology.sink_parallelism = 1
+    cfg_a.topology.max_spout_pending = 512
+    cfg_a.topology.message_timeout_s = 300.0
+    cfg_a.offsets.policy = "earliest"
+    cfg_a.offsets.max_behind = None
+    cfg_a.tracing.sample_rate = 0.0
+    cfg_a.obs = obs_cfg
+    payloads_a = make_payloads(lenet, n_distinct=16, instances_per_msg=8)
+    broker_a = MemoryBroker(default_partitions=2)
+    arm_a = run_arm(
+        "bn-infer", cfg_a, build_standard_topology,
+        backlog=1024, window_s=10.0, expected="inference-bolt",
+        produce=lambda i: broker_a.produce(
+            cfg_a.broker.input_topic, payloads_a[i % len(payloads_a)]),
+        out_size=lambda: broker_a.topic_size(cfg_a.broker.output_topic),
+        broker=broker_a)
+
+    # Arm B — ingest-bound: NullEngine behind 2 tasks, the spout paying
+    # one TCP fetch round trip PER RECORD (fetch_size=1 against the
+    # wire broker) — downstream idles, the single spout task saturates.
+    def build_fetch1_null(cfg: Config, broker):
+        from storm_tpu.connectors import BrokerSink, BrokerSpout
+        from storm_tpu.infer import InferenceBolt
+        from storm_tpu.infer.engine import NullEngine
+        from storm_tpu.runtime import TopologyBuilder
+
+        engine = NullEngine(cfg.model.input_shape, cfg.model.num_classes)
+        tb = TopologyBuilder()
+        tb.set_spout("kafka-spout",
+                     BrokerSpout(broker, cfg.broker.input_topic,
+                                 cfg.offsets, fetch_size=1,
+                                 scheme="string"),
+                     parallelism=cfg.topology.spout_parallelism)
+        tb.set_bolt("inference-bolt",
+                    InferenceBolt(cfg.model, cfg.batch, cfg.sharding,
+                                  engine=engine, warmup=False),
+                    parallelism=cfg.topology.inference_parallelism
+                    ).shuffle_grouping("kafka-spout")
+        tb.set_bolt("kafka-bolt",
+                    BrokerSink(broker, cfg.broker.output_topic, cfg.sink),
+                    parallelism=cfg.topology.sink_parallelism
+                    ).shuffle_grouping("inference-bolt")
+        tb.set_bolt("dlq-bolt",
+                    BrokerSink(broker, cfg.broker.dead_letter_topic,
+                               cfg.sink),
+                    parallelism=1
+                    ).shuffle_grouping("inference-bolt",
+                                       stream="dead_letter")
+        return tb.build()
+
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+    from tests.kafka_stub import KafkaStubBroker
+
+    stub_b = KafkaStubBroker(partitions=2)
+    cfg_b = null_cfg()
+    cfg_b.broker.kind = "kafka"
+    cfg_b.broker.bootstrap = f"127.0.0.1:{stub_b.port}"
+    try:
+        wire_b = KafkaWireBroker(cfg_b.broker.bootstrap)
+        arm_b = run_arm(
+            "bn-spout", cfg_b, build_fetch1_null,
+            backlog=4000, window_s=10.0, expected="kafka-spout",
+            produce=lambda i: wire_b.produce(cfg_b.broker.input_topic,
+                                             tiny_payload.decode()),
+            out_size=lambda: stub_b.topic_size(cfg_b.broker.output_topic),
+            broker=wire_b)
+    finally:
+        stub_b.close()
+
+    # Observatory-cost A/B: same NullEngine topology, Observatory
+    # attached vs detached, interleaved at cell level.
+    repeats = max(3, args.repeats)
+    # Multi-second measured windows: this can be a 1-core host where a
+    # sub-second drain window is pure scheduler noise (first capture of
+    # this A/B swung +-17% with 0.3 s windows).
+    n_msgs, warm = 20000, 2000
+    ab_cfg = null_cfg()
+    broker = MemoryBroker(default_partitions=2)
+    cluster = LocalCluster()
+    try:
+        cluster.submit_topology("bn-ab", ab_cfg,
+                                build_null_engine_topology(ab_cfg, broker))
+
+        def cell(arm, rep):
+            obs = None
+            if arm == "obs_on":
+                async def mk():
+                    rt = cluster._cluster.runtime("bn-ab")
+                    return Observatory(rt, obs_cfg,
+                                       sink_components=("kafka-bolt",)
+                                       ).start()
+
+                obs = cluster._run(mk())
+            base = broker.topic_size(ab_cfg.broker.output_topic)
+            total = warm + n_msgs
+            for _ in range(total):
+                broker.produce(ab_cfg.broker.input_topic, tiny_payload)
+            elapsed, done = timed_drain_window(
+                lambda: broker.topic_size(ab_cfg.broker.output_topic) - base,
+                warm, total)
+            if obs is not None:
+                cluster._run(obs.stop())
+            if elapsed != elapsed or done < total:
+                raise RuntimeError(
+                    f"bn-ab {arm} rep{rep}: only {done}/{total} outputs")
+            rate = n_msgs / elapsed
+            log(f"  overhead A/B {arm} rep{rep}: {rate:.0f} msg/s")
+            return rate
+
+        samples = run_interleaved(("obs_on", "obs_off"), repeats, cell)
+        cluster.kill_topology("bn-ab", wait_secs=2)
+    finally:
+        cluster.shutdown()
+    on = arm_stats(samples["obs_on"])
+    off = arm_stats(samples["obs_off"])
+    overhead_pct = round(
+        (off["msgs_per_sec"] - on["msgs_per_sec"])
+        / off["msgs_per_sec"] * 100.0, 2) if off["msgs_per_sec"] else None
+
+    # Dist probe: 2-worker cluster, NullEngine builder, spout on worker 0
+    # and everything else on worker 1 — the controller-merged windowed
+    # utilization must attribute each component to its hosting worker.
+    def dist_probe() -> dict:
+        from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+        from storm_tpu.dist import DistCluster
+        from tests.kafka_stub import KafkaStubBroker
+
+        stub = KafkaStubBroker(partitions=2)
+        cfg = null_cfg()
+        cfg.broker.kind = "kafka"
+        cfg.broker.bootstrap = f"127.0.0.1:{stub.port}"
+        cfg.broker.input_topic = "bn-in"
+        cfg.broker.output_topic = "bn-out"
+        cfg.broker.dead_letter_topic = "bn-dlq"
+        placement = {"kafka-spout": 0, "inference-bolt": 1,
+                     "kafka-bolt": 1, "dlq-bolt": 1}
+        n = 1500
+        try:
+            with DistCluster(2, env={"JAX_PLATFORMS": "cpu",
+                                     "STORM_TPU_PLATFORM": "cpu"}) as dc:
+                producer = KafkaWireBroker(cfg.broker.bootstrap)
+                for _ in range(n):
+                    producer.produce("bn-in", tiny_payload.decode())
+                dc.submit("bn-dist", cfg, placement, builder="null")
+                prime = dc.utilization("bench")
+                drained = await_outputs(lambda: stub.topic_size("bn-out"),
+                                        n, grace_s=180.0)
+                out = dc.utilization("bench")
+                dc.drain(timeout_s=30)
+                dc.kill()
+        finally:
+            stub.close()
+        comps = out["components"]
+        inf = comps.get("inference-bolt", {})
+        spout = comps.get("kafka-spout", {})
+        ok = bool(
+            drained
+            and prime["components"] == {}  # first call = zero-length window
+            and comps
+            and inf.get("busy_s", 0.0) > 0.0
+            and inf.get("capacity") is not None
+            and inf.get("dt_s", 0.0) > 0.0
+            and spout.get("workers") == [0]
+            and inf.get("workers") == [1])
+        log(f"  dist probe: ok={ok} components={sorted(comps)}")
+        return {"ok": ok, "drained": drained,
+                "first_call_primed_empty": prime["components"] == {},
+                "merged": comps,
+                "per_worker": {str(i): w for i, w in out["workers"].items()}}
+
+    dist = dist_probe()
+
+    attribution_ok = bool(arm_a["correct"] and arm_b["correct"])
+    overhead_ok = bool(overhead_pct is not None and overhead_pct <= 2.0)
+    return {
+        "metric": "bottleneck_attribution_arms_correct",
+        "value": int(arm_a["correct"]) + int(arm_b["correct"]),
+        "unit": ("induced-limiter arms the attributor named correctly "
+                 "(majority of mid-drain /bottleneck route samples), "
+                 "out of 2"),
+        "arms": [arm_a, arm_b],
+        "overhead_pct": overhead_pct,
+        "obs_on": on,
+        "obs_off": off,
+        "repeats": repeats,
+        "attribution_ok": attribution_ok,
+        "overhead_ok": overhead_ok,
+        "dist_utilization": dist,
+        "dist_utilization_ok": dist["ok"],
+        "config": "bottleneck+lenet5/null",
+        "capture_session": _new_capture_session(),
+        "code_version": _code_version(),
+        "note": ("per-tuple executor clock reads run in BOTH overhead "
+                 "arms (they are constitutive, ~2 perf_counter calls per "
+                 "tuple); the A/B prices the Observatory sampling + "
+                 "attribution layer at interval_s=0.2. Negative overhead "
+                 "= the on arm measured faster, i.e. the true cost is "
+                 "below this host's run-to-run noise"),
+    }
+
+
 def run_autoscale(args) -> dict:
     """``--autoscale``: the reference's scaling thesis as a measured closed
     loop (README.md:13-14 — "input rate rises, latency grows -> scale the
@@ -3103,6 +3458,13 @@ def main() -> None:
                          "attached: burn-rate gauge vs shed_level "
                          "timeline + live /profile route probe -> "
                          "BENCH_SLO_BURN artifact")
+    ap.add_argument("--bottleneck", action="store_true",
+                    help="bottleneck attributor vs two induced limiters "
+                         "(inference-bound lenet5 vs spout-bound null "
+                         "engine, verdicts via live /bottleneck route) + "
+                         "Observatory on/off interleaved A/B + dist "
+                         "merged-utilization probe -> BENCH_BOTTLENECK "
+                         "artifact (bars: both arms named, <= 2%%)")
     ap.add_argument("--slo-sweep", action="store_true",
                     help="sweep offered rate; report latency-vs-rate curve "
                          "+ max img/s/chip under measured p50 <= 50/100/"
@@ -3127,6 +3489,9 @@ def main() -> None:
         return
     if args.slo_burn:
         print(json.dumps(run_slo_burn(args)))
+        return
+    if args.bottleneck:
+        print(json.dumps(run_bottleneck(args)))
         return
     if args.cascade_compare:
         print(json.dumps(run_cascade_compare(args)))

@@ -520,10 +520,29 @@ class ObsConfig:
     regression_factor: float = 1.5
     sentinel_interval_s: float = 10.0
     min_samples: int = 20
+    # Bottleneck attribution (obs/bottleneck.py): a component counts as
+    # "at capacity" above capacity_hot busy-fraction of the wallclock
+    # window (also the Autoscaler's named-bottleneck scale-up trigger);
+    # an edge is "growing" above lag_growth_eps rows/s; a saturated but
+    # no-longer-growing inbox still attributes above lag_depth_hot
+    # queued records; no leader is named below bottleneck_min_score
+    # (an idle topology has no bottleneck).
+    capacity_hot: float = 0.8
+    lag_growth_eps: float = 1.0
+    lag_depth_hot: int = 64
+    bottleneck_min_score: float = 0.4
 
     def __post_init__(self) -> None:
         if self.interval_s <= 0 or self.sentinel_interval_s <= 0:
             raise ValueError("obs intervals must be > 0")
+        if not 0.0 < float(self.capacity_hot) <= 1.0:
+            raise ValueError(
+                f"obs.capacity_hot must be in (0, 1], got "
+                f"{self.capacity_hot!r}")
+        if self.lag_growth_eps < 0 or self.lag_depth_hot < 0:
+            raise ValueError("obs lag thresholds must be >= 0")
+        if self.bottleneck_min_score < 0:
+            raise ValueError("obs.bottleneck_min_score must be >= 0")
         if not 0.0 < float(self.slo_objective) < 1.0:
             raise ValueError(
                 f"obs.slo_objective must be in (0, 1), got "

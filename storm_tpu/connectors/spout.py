@@ -299,6 +299,26 @@ class BrokerSpout(Spout):
                               self.broker.latest_offset(self.topic, p)))
             self.positions[p] = pos
 
+    def ingress_lag(self) -> dict:
+        """How far this task's cursor trails the broker's high-water mark,
+        summed over owned partitions — the obs edge watermarks' *ingress*
+        row (EdgeLagTracker), i.e. the lag Storm/Burrow would chart for the
+        consumer group. Blocking (wire) brokers answer offset queries with
+        a network round trip that must not run on the event loop, so for
+        them ``records_behind`` is None (unknown), not 0 — callers must
+        treat None as "no data", never "caught up"."""
+        if self._blocking:
+            return {"records_behind": None,
+                    "partitions": len(self.my_partitions)}
+        behind = 0
+        for p in self.my_partitions:
+            pos = self.positions.get(p)
+            if pos is None:
+                continue
+            behind += max(0, self.broker.latest_offset(self.topic, p) - pos)
+        return {"records_behind": behind,
+                "partitions": len(self.my_partitions)}
+
     async def next_tuple(self) -> bool:
         if self._membership is not None:
             await self._group_poll()

@@ -60,6 +60,36 @@ def _probe_raw_spouts(cfg, builder: str) -> list:
         if getattr(spec.obj, "scheme", None) == "raw")
 
 
+def merge_utilization(per_worker: Dict[int, dict]) -> Dict[str, dict]:
+    """Fuse per-worker utilization snapshots (``obs.capacity.
+    utilization_snapshot`` payloads) into one per-component view.
+
+    Raw busy/wait/flush seconds and task counts ADD across workers;
+    ``dt_s`` takes the max (each worker measured roughly the same wall
+    window — summing would double-count time); capacity and the fractions
+    are then re-derived from the merged totals, exactly the formula
+    ``obs.capacity._finish_row`` applies per process. Each row also keeps
+    the contributing worker indices. Per-worker transport depths stay in
+    the caller's ``workers`` payload — they are per-peer-link, so a
+    cross-worker sum would have no referent."""
+    from storm_tpu.obs.capacity import _finish_row
+
+    merged: Dict[str, dict] = {}
+    for i, snap in per_worker.items():
+        for comp, row in (snap.get("components") or {}).items():
+            m = merged.setdefault(comp, {
+                "component": comp, "tasks": 0, "busy_s": 0.0,
+                "wait_s": 0.0, "flush_s": 0.0, "dt_s": 0.0, "workers": []})
+            m["tasks"] += int(row.get("tasks", 0))
+            for k in ("busy_s", "wait_s", "flush_s"):
+                m[k] += float(row.get(k, 0.0))
+            m["dt_s"] = max(m["dt_s"], float(row.get("dt_s", 0.0)))
+            m["workers"].append(i)
+    for m in merged.values():
+        _finish_row(m)
+    return merged
+
+
 class DistCluster:
     def __init__(
         self,
@@ -337,6 +367,20 @@ class DistCluster:
                 if self._placement.get(comp, 0) == i or comp not in merged:
                     merged[comp] = vals
         return merged
+
+    def utilization(self, key: str = "dist") -> Dict[str, Any]:
+        """Cluster-wide windowed utilization: every worker reports its
+        busy/wait/flush deltas since the last ``utilization`` call with
+        the same ``key`` (cursors live worker-side), and the controller
+        merges them per component. The first call primes the cursors and
+        reports empty components — sample twice around a traffic window.
+        Unlike ``metrics()`` there is no hosting-worker-wins rule: a
+        rebalance can leave tasks of one component on several workers, so
+        raw seconds are summed and capacity recomputed from the totals."""
+        per_worker = {i: c.control("utilization", key=key)["utilization"]
+                      for i, c in enumerate(self.clients)}
+        return {"workers": per_worker,
+                "components": merge_utilization(per_worker)}
 
     def health(self) -> Dict[int, dict]:
         return {i: c.control("health")["health"]

@@ -171,6 +171,19 @@ class DistRuntimeView:
     async def traces(self, n: int = 20) -> Dict[str, Any]:
         return await asyncio.to_thread(self._dist.traces, n)
 
+    async def bottleneck(self) -> Dict[str, Any]:
+        """Dist flavor of the /bottleneck action: merged windowed
+        utilization per component (controller cursors under the "ui"
+        key, so this route's window is between ITS OWN calls, never
+        stealing the bench/Observatory deltas). No cross-worker
+        attributor runs controller-side — ``bottleneck`` is None and the
+        per-component capacity table is the verdict."""
+        out = await asyncio.to_thread(self._dist.utilization, "ui")
+        return {"topology": self.name,
+                "utilization": out["components"],
+                "workers": out["workers"],
+                "bottleneck": None}
+
     async def worker_logs(self, index: int, tail_bytes: int = 16384) -> str:
         return await asyncio.to_thread(self._dist.worker_logs, index, tail_bytes)
 

@@ -630,6 +630,17 @@ class WorkerServer:
             return {"ok": True}
         if cmd == "metrics":
             return {"metrics": self.rt.metrics.snapshot()}
+        if cmd == "utilization":
+            # This worker's busy/wait/flush deltas since the LAST
+            # utilization call with the same key (windowed cursors live on
+            # the runtime) plus outbound transport queue depths. The
+            # controller sums the raw seconds across workers and recomputes
+            # capacity — fractions don't merge, seconds do.
+            from storm_tpu.obs.capacity import utilization_snapshot
+
+            return {"index": self.index,
+                    "utilization": utilization_snapshot(
+                        self.rt, key=str(req.get("key", "dist")))}
         if cmd == "traces":
             # This worker's slice of the distributed trace picture: the
             # controller (UI /traces action) merges slices from every

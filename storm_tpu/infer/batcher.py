@@ -77,6 +77,23 @@ class MicroBatcher:
     def oldest_ts(self) -> Optional[float]:
         return self._items[0].ts if self._items else None
 
+    def stats(self) -> dict:
+        """Depth/age summary, key-parity with ``LaneBatcher.stats`` and
+        the queue half of ``ContinuousBatcher.stats`` — the obs edge
+        watermarks read every batching mode through one shape. Age is
+        measured from batcher *entry* (``enq``), not the deadline clock:
+        it answers "how long has work sat here", not "how late is it"."""
+        now = time.perf_counter()
+        oldest = self._items[0].enq if self._items else None
+        return {
+            "kind": "fifo",
+            "pending_rows": self._count,
+            "depth": len(self._items),
+            "oldest_ms": (round(max(0.0, (now - oldest) * 1e3), 3)
+                          if oldest is not None else 0.0),
+            "pending_by_lane": {},
+        }
+
     def add(self, payload: Any, data: np.ndarray, ts: Optional[float] = None) -> Optional[Batch]:
         """Add one record (n_i instances). Returns a ready Batch when the
         max_batch threshold is reached, else None.

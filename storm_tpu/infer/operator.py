@@ -361,6 +361,27 @@ class InferenceBolt(Bolt):
     def _pending(self) -> int:
         return sum(len(b) for _, b in self._sources)
 
+    def batcher_stats(self) -> dict:
+        """Aggregate depth/age of this task's admission batcher(s) — the
+        obs edge watermarks (EdgeLagTracker) read every batching mode
+        through this one shape. Continuous mode reports ~0 here by
+        design: batch formation lives in the shared engine queue, whose
+        depth/oldest-age surface via ``ContinuousBatcher.stats`` and
+        ``Observatory.occupancy``."""
+        rows = depth = 0
+        oldest_ms = 0.0
+        for _tier, b in self._sources:
+            stats_fn = getattr(b, "stats", None)
+            if stats_fn is None:
+                continue
+            st = stats_fn()
+            rows += st["pending_rows"]
+            depth += st["depth"]
+            oldest_ms = max(oldest_ms, st["oldest_ms"])
+        return {"pending_rows": rows, "depth": depth,
+                "oldest_ms": round(oldest_ms, 3),
+                "continuous": bool(getattr(self, "_continuous", False))}
+
     def _kick_flush(self) -> None:
         try:
             asyncio.get_running_loop()

@@ -282,6 +282,12 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
             ).start()
             for i, (infer_id, sink_id) in enumerate(pairs)
         ]
+        if observatory is not None:
+            # Bottleneck verdicts become a scale-up signal: a scaler
+            # whose component is the NAMED bottleneck at capacity goes
+            # hot even before the latency policy trips.
+            for scaler in scalers:
+                scaler.bottleneck = observatory.bottleneck
     ui = None
     if ui_port >= 0:
         from storm_tpu.runtime.ui import UIServer
@@ -559,6 +565,90 @@ def _profile_cmd(args) -> int:
     return 0
 
 
+def _bottleneck_cmd(args) -> int:
+    """Render the bottleneck observatory's verdict from a running
+    topology's UI endpoint (storm-tpu bottleneck <topology>): ranked
+    per-component capacity table, edge lag watermarks, and the
+    critical-path latency decomposition. Against a dist UI the table is
+    the controller-merged per-worker utilization (no attributor runs
+    cross-worker)."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from storm_tpu.config import env_control_token
+
+    base = args.url.rstrip("/")
+    topo = urllib.parse.quote(args.topology, safe="")
+    req = urllib.request.Request(f"{base}/api/v1/topology/{topo}/bottleneck")
+    token = args.token or env_control_token()
+    if token:  # read route is open; header is harmless if unneeded
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        print(e.read().decode("utf-8", "replace"), file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    verdict = out.get("bottleneck") or {}
+    leader = verdict.get("leader")
+    print(f"bottleneck: {leader if leader else '(none above threshold)'}")
+    ranked = verdict.get("ranked") or []
+    util = out.get("utilization") or {}
+    if ranked:
+        print(f"{'component':<24} {'score':>6} {'cap':>6} {'busy':>6} "
+              f"{'wait':>6} {'inflow':>8}  reasons")
+        for row in ranked:
+            print(f"{row['component']:<24} {row['score']:>6} "
+                  f"{_fmt(row.get('capacity')):>6} "
+                  f"{_fmt(row.get('busy_frac')):>6} "
+                  f"{_fmt(row.get('wait_frac')):>6} "
+                  f"{_fmt(row.get('inflow_growth_per_s')):>8}  "
+                  f"{','.join(row.get('reasons') or []) or '-'}")
+    elif util:
+        # dist view (or local before the first Observatory tick): plain
+        # merged utilization table, no scores
+        print(f"{'component':<24} {'cap':>6} {'busy':>6} {'wait':>6} "
+              f"{'flush':>6} {'tasks':>5}  workers")
+        for comp, row in util.items():
+            print(f"{comp:<24} {_fmt(row.get('capacity')):>6} "
+                  f"{_fmt(row.get('busy_frac')):>6} "
+                  f"{_fmt(row.get('wait_frac')):>6} "
+                  f"{_fmt(row.get('flush_frac')):>6} "
+                  f"{row.get('tasks', '?'):>5}  "
+                  f"{row.get('workers', '-')}")
+    else:
+        print("no utilization window yet (obs enabled? traffic flowing?)")
+    for row in verdict.get("edges") or []:
+        print(f"edge {row['edge']:<30} depth={row['depth']:<6} "
+              f"growth={_fmt(row['growth_per_s'])}/s")
+    for row in verdict.get("ingress") or []:
+        print(f"ingress {row['component']}[{row['task']}]: "
+              f"behind={row['records_behind']} "
+              f"partitions={row['partitions']}")
+    cp = verdict.get("critical_path") or {}
+    stages = cp.get("stages") or {}
+    if stages:
+        print(f"critical path (e2e mean={cp.get('e2e_mean_ms')}ms "
+              f"p95={cp.get('e2e_p95_ms')}ms, n={cp.get('records')}):")
+        for name, st in stages.items():
+            sub = st.get("substages_ms")
+            extra = f"  {sub}" if sub else ""
+            print(f"  {name:<26} {_fmt(st.get('mean_ms')):>9}ms "
+                  f"frac={_fmt(st.get('frac_of_e2e'))}{extra}")
+    return 0
+
+
+def _fmt(v):
+    return "-" if v is None else v
+
+
 def main(argv=None) -> int:
     setup_logging()
     ap = argparse.ArgumentParser(prog="storm_tpu")
@@ -727,6 +817,22 @@ def main(argv=None) -> int:
     profp.add_argument("--json", action="store_true",
                        help="raw JSON instead of the rendered view")
 
+    bottp = sub.add_parser(
+        "bottleneck",
+        help="show where a running topology is limited: ranked "
+             "per-component capacity, edge lag watermarks, and the "
+             "critical-path latency decomposition (needs [obs] enabled "
+             "on the daemon; dist UIs answer with merged per-worker "
+             "utilization)")
+    bottp.add_argument("topology")
+    bottp.add_argument("--url", default="http://127.0.0.1:8080",
+                       help="base URL of the daemon's --ui-port server")
+    bottp.add_argument("--token", default=None,
+                       help="bearer token (default: "
+                            "$STORM_TPU_CONTROL_TOKEN)")
+    bottp.add_argument("--json", action="store_true",
+                       help="raw JSON instead of the rendered view")
+
     args = ap.parse_args(argv)
 
     if args.cmd == "run":
@@ -754,6 +860,9 @@ def main(argv=None) -> int:
 
     if args.cmd == "profile":
         return _profile_cmd(args)
+
+    if args.cmd == "bottleneck":
+        return _bottleneck_cmd(args)
 
     if args.cmd == "dist-run":
         cfg = _load_config(args)

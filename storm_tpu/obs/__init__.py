@@ -11,14 +11,25 @@ needs before it can solve for a config:
 - :mod:`storm_tpu.obs.slo` — :class:`SloBurnTracker`, multi-window
   error-budget burn from the sink's delivered/slo_breaches counters;
   an additional hot signal for the LoadShedController.
+- :mod:`storm_tpu.obs.capacity` — :class:`CapacityTracker` (per-executor
+  busy/wait/flush windowed utilization, Storm-style capacity gauges) and
+  :class:`EdgeLagTracker` (per-edge inbox depth + growth, batcher queue
+  ages, spout ingress lag, dist transport depth).
+- :mod:`storm_tpu.obs.bottleneck` — :class:`BottleneckAttributor`, the
+  ranked per-component verdict + critical-path latency decomposition
+  over those signals; ``bottleneck_shift`` flight events on leader
+  change. The Autoscaler consumes the named leader as an additional
+  scale-up signal.
 - :class:`Observatory` (here) — the per-topology control loop: steps the
   burn tracker, publishes occupancy gauges (pipeline-ring slots,
-  continuous-queue depth/oldest-age, StagingPool utilization), and runs
-  the regression sentinel that compares live curves against a loaded
-  baseline, recording ``profile_regression`` flight events on drift.
+  continuous-queue depth/oldest-age, StagingPool utilization), steps
+  the bottleneck attributor, and runs the regression sentinel that
+  compares live curves against a loaded baseline, recording
+  ``profile_regression`` flight events on drift.
 
-Everything surfaces through the ``/api/v1/topology/{name}/profile`` UI
-route and the ``storm-tpu profile`` CLI subcommand; config knobs live in
+Everything surfaces through the ``/api/v1/topology/{name}/profile`` and
+``.../bottleneck`` UI routes and the ``storm-tpu profile`` /
+``storm-tpu bottleneck`` CLI subcommands; config knobs live in
 ``ObsConfig`` (``[obs]``).
 """
 
@@ -29,6 +40,12 @@ import logging
 import time
 from typing import List, Optional, Sequence
 
+from storm_tpu.obs.bottleneck import BottleneckAttributor
+from storm_tpu.obs.capacity import (
+    CapacityTracker,
+    EdgeLagTracker,
+    utilization_snapshot,
+)
 from storm_tpu.obs.profile import (
     ProfileStore,
     ensure_installed,
@@ -40,12 +57,16 @@ from storm_tpu.obs.slo import SloBurnTracker
 log = logging.getLogger("storm_tpu.obs")
 
 __all__ = [
+    "BottleneckAttributor",
+    "CapacityTracker",
+    "EdgeLagTracker",
     "Observatory",
     "ProfileStore",
     "SloBurnTracker",
     "ensure_installed",
     "profile_store",
     "set_enabled",
+    "utilization_snapshot",
 ]
 
 
@@ -73,6 +94,13 @@ class Observatory:
             clock=clock,
         )
         self.clock = clock
+        # Bottleneck observatory (obs/capacity + obs/bottleneck): windowed
+        # executor utilization, edge lag watermarks, and the ranked
+        # attribution verdict, stepped with the rest of the control loop.
+        self.capacity = CapacityTracker(runtime, clock=clock)
+        self.lag = EdgeLagTracker(runtime, clock=clock)
+        self.bottleneck = BottleneckAttributor(
+            runtime, self.cfg, self.capacity, self.lag, clock=clock)
         self.last_regressions: List[dict] = []
         self._m_regress = runtime.metrics.counter("obs", "profile_regressions")
         self._last_sentinel = clock()
@@ -119,6 +147,7 @@ class Observatory:
     def step(self) -> None:
         self.burn.step()
         self._sample_occupancy()
+        self.bottleneck.step()
         now = self.clock()
         if now - self._last_sentinel >= self.cfg.sentinel_interval_s:
             self._last_sentinel = now
@@ -190,4 +219,19 @@ class Observatory:
             "occupancy": self.occupancy(),
             "regressions": self.last_regressions,
             "baseline_loaded": self.profile.baseline is not None,
+            "utilization": self.capacity.last,
+            "bottleneck": self.last_verdict(),
         }
+
+    def last_verdict(self) -> dict:
+        """Latest attribution verdict (headline of the /bottleneck route).
+
+        Empty until the first step with traffic: the route reports the
+        control loop's view rather than racing an extra sample against
+        it (both would advance the same windowed cursors)."""
+        return self.bottleneck.last_verdict
+
+    def bottleneck_snapshot(self) -> dict:
+        return {"utilization": self.capacity.last,
+                "bottleneck": self.last_verdict(),
+                "interval_s": self.cfg.interval_s}

@@ -48,6 +48,27 @@ class LaneBatcher:
             return None
         return min(entry[2].ts for entry in self._heap)
 
+    def stats(self) -> dict:
+        """Depth/age summary, key-parity with ``MicroBatcher.stats`` and
+        the queue half of ``ContinuousBatcher.stats`` (the obs edge
+        watermarks read every batching mode through one shape), plus the
+        per-lane pending split only this batcher can attribute. Age is
+        from batcher entry (``enq``) — queue dwell, not deadline slack."""
+        now = time.perf_counter()
+        oldest = min((entry[2].enq for entry in self._heap), default=None)
+        by_lane: dict = {}
+        for _deadline, _seq, item in self._heap:
+            lane = item.lane or ""
+            by_lane[lane] = by_lane.get(lane, 0) + item.data.shape[0]
+        return {
+            "kind": "lane",
+            "pending_rows": self._count,
+            "depth": len(self._heap),
+            "oldest_ms": (round(max(0.0, (now - oldest) * 1e3), 3)
+                          if oldest is not None else 0.0),
+            "pending_by_lane": by_lane,
+        }
+
     def add(self, payload: Any, data: np.ndarray,
             ts: Optional[float] = None,
             lane: Optional[str] = None) -> Optional[Batch]:

@@ -78,6 +78,15 @@ class Autoscaler:
         # expensive scale-out, and a transient spike the shedder absorbs
         # never pays a rebalance at all.
         self.shedder = shedder
+        # Bottleneck-aware scale-up (obs.bottleneck): attach the topology's
+        # BottleneckAttributor (``scaler.bottleneck = obs.bottleneck``, same
+        # idiom as ``shedder.burn = obs.burn``) and saturation of the policy
+        # component becomes a third hot signal — the attributor must NAME
+        # this component the current leader AND report its capacity at or
+        # above the obs ``capacity_hot`` threshold. Scaling the *named*
+        # bottleneck means a component pegged at capacity scales before its
+        # queue backs up far enough to move p50/inbox_frac.
+        self.bottleneck = None
         self._deferred = 0
         self._task: Optional[asyncio.Task] = None
         self._calm = 0
@@ -118,8 +127,27 @@ class Autoscaler:
             (e.inbox.qsize() / max(1, e.inbox.maxsize) for e in execs), default=0.0
         )
 
-        hot = (p50 is not None and p50 > p.high_ms) or inbox_frac > 0.5
-        calm = (p50 is None or p50 < p.low_ms) and inbox_frac < 0.05
+        # Third signal (when an attributor is attached): the bottleneck
+        # observatory names this very component as the topology's limiter
+        # and it is running hot. Read, never sampled here — the Observatory
+        # loop owns the capacity cursors; step() only consumes its verdict.
+        capacity = None
+        cap_hot = False
+        bn = self.bottleneck
+        if bn is not None:
+            verdict = getattr(bn, "last_verdict", None) or {}
+            if verdict.get("leader") == p.component:
+                for row in verdict.get("ranked", ()):
+                    if row.get("component") == p.component:
+                        capacity = row.get("capacity")
+                        break
+                cap_hot = (capacity is not None
+                           and capacity >= bn.cfg.capacity_hot)
+
+        hot = (p50 is not None and p50 > p.high_ms) or inbox_frac > 0.5 \
+            or cap_hot
+        calm = ((p50 is None or p50 < p.low_ms) and inbox_frac < 0.05
+                and not cap_hot)
 
         if hot:
             self._hot += 1
@@ -142,7 +170,8 @@ class Autoscaler:
                 log.info(
                     "scale-up of %s deferred one interval (shedder level 0)",
                     p.component)
-                self._flight("defer", current, current, p50, inbox_frac)
+                self._flight("defer", current, current, p50, inbox_frac,
+                             capacity, cap_hot)
                 return None
             self._deferred = 0
             new = current + 1
@@ -152,7 +181,8 @@ class Autoscaler:
             )
             await self.rt.rebalance(p.component, new)
             self.decisions.append(("up", current, new))
-            self._flight("up", current, new, p50, inbox_frac)
+            self._flight("up", current, new, p50, inbox_frac,
+                         capacity, cap_hot)
             self._hot = 0
             return new
         if self._calm >= p.cooldown and current > p.min_parallelism:
@@ -160,15 +190,21 @@ class Autoscaler:
             log.info("scaling %s DOWN %d->%d (p50=%s ms)", p.component, current, new, p50)
             await self.rt.rebalance(p.component, new)
             self.decisions.append(("down", current, new))
-            self._flight("down", current, new, p50, inbox_frac)
+            self._flight("down", current, new, p50, inbox_frac,
+                         capacity, cap_hot)
             self._calm = 0
             return new
         return None
 
     def _flight(self, direction: str, current: int, new: int,
-                p50, inbox_frac: float) -> None:
+                p50, inbox_frac: float, capacity=None,
+                bottleneck: bool = False) -> None:
         """Flight-recorder breadcrumb: every scaling decision plus the
-        signals that drove it, for post-mortems of soak/chaos runs."""
+        signals that drove it, for post-mortems of soak/chaos runs.
+        ``capacity``/``bottleneck`` record the attributor's view of the
+        policy component at decision time (None/False when no attributor
+        is attached), so a post-mortem can tell a latency-triggered scale
+        from a capacity-triggered one."""
         flight = getattr(self.rt, "flight", None)
         if flight is not None:
             flight.event(
@@ -176,4 +212,5 @@ class Autoscaler:
                 direction=direction, parallelism=(current, new),
                 p50_ms=round(p50, 3) if p50 is not None else None,
                 inbox_frac=round(inbox_frac, 3),
+                capacity=capacity, bottleneck=bool(bottleneck),
             )

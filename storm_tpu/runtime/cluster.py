@@ -57,6 +57,16 @@ class Router:
                 if group.component_id == component_id:
                     grouping.prepare(len(group.inboxes))
 
+    def edges(self):
+        """(source, stream, TargetGroup) rows — the observatory's
+        read-only view of the routing table (obs/capacity.EdgeLagTracker
+        derives per-edge depth/growth watermarks from the target
+        inboxes). One row per subscription; consumers dedupe by
+        (source, stream, dst) if two groupings share an edge."""
+        for (source, stream), subs in list(self._subs.items()):
+            for _grouping, group in subs:
+                yield source, stream, group
+
 
 class TopologyRuntime:
     """Everything live for one submitted topology."""

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import copy
 import logging
+import time
 import traceback
 from typing import Any, Optional
 
@@ -52,6 +53,15 @@ class BoltExecutor:
         self.n_executed = 0
         self.exec_ms_total = 0.0
         self.n_errors = 0
+        # Busy/idle wall-time split (Storm UI's "capacity" input, consumed
+        # by obs/capacity.CapacityTracker as windowed deltas): seconds in
+        # execute/tick vs blocked on the inbox vs the final drain flush.
+        # ``clock`` is injectable so tests drive the split without sleeps;
+        # set it before start() — _run binds it locally.
+        self.clock = time.perf_counter
+        self.busy_s = 0.0
+        self.wait_s = 0.0
+        self.flush_s = 0.0
         self._task: Optional[asyncio.Task] = None
         self._tick_task: Optional[asyncio.Task] = None
         self._ckpt_task: Optional[asyncio.Task] = None
@@ -128,14 +138,15 @@ class BoltExecutor:
                 pass
 
     async def _run(self) -> None:
-        import time as _time
-
         m = self.rt.metrics
         executed = m.counter(self.component_id, "executed")
         exec_ms = m.histogram(self.component_id, "execute_ms")
         tracer = getattr(self.rt, "tracer", None)
+        clock = self.clock
         while True:
+            w0 = clock()
             item = await self.inbox.get()
+            self.wait_s += clock() - w0
             if item is _STOP:
                 break
             if item is _CKPT:
@@ -148,20 +159,25 @@ class BoltExecutor:
             t: Tuple = item
             try:
                 if is_tick(t):
-                    await self.bolt.tick()
+                    t0 = clock()
+                    try:
+                        await self.bolt.tick()
+                    finally:
+                        self.busy_s += clock() - t0
                 else:
                     executed.inc()
                     self.n_executed += 1
-                    t0 = _time.perf_counter()
+                    t0 = clock()
                     try:
                         await self.bolt.execute(t)
                     finally:
                         # Count time for failed executes too, or a failing
                         # bolt reports a misleadingly low average.
-                        t1 = _time.perf_counter()
+                        t1 = clock()
                         dt_ms = (t1 - t0) * 1e3
                         exec_ms.observe(dt_ms)
                         self.exec_ms_total += dt_ms
+                        self.busy_s += t1 - t0
                         if t.trace is not None and tracer is not None:
                             tracer.record(t.trace, "execute",
                                           self.component_id, t0, t1)
@@ -186,12 +202,15 @@ class BoltExecutor:
                 await asyncio.wait_for(self._task, timeout=30.0)
             except asyncio.TimeoutError:  # pragma: no cover
                 self._task.cancel()
+            f0 = self.clock()
             try:
                 # Settle deferred work (pending batches, in-flight sends)
                 # before cleanup closes resources under it.
                 await asyncio.wait_for(self.bolt.flush(), timeout=30.0)
             except Exception as e:
                 log.warning("flush error in %s: %s", self.component_id, e)
+            finally:
+                self.flush_s += self.clock() - f0
             if self._stateful:
                 # Final checkpoint: a graceful stop must not lose the tail
                 # of state updates since the last periodic snapshot.
@@ -231,6 +250,13 @@ class SpoutExecutor:
         self.n_acked = 0
         self.n_failed = 0
         self.n_errors = 0
+        # Busy/idle split (see BoltExecutor): emitting polls are busy;
+        # pending-slot waits, idle backoff, and empty polls are wait.
+        # flush_s exists only for surface parity with bolts.
+        self.clock = time.perf_counter
+        self.busy_s = 0.0
+        self.wait_s = 0.0
+        self.flush_s = 0.0
         self._slot = asyncio.Event()
         self._slot.set()
         self._task: Optional[asyncio.Task] = None
@@ -276,11 +302,16 @@ class SpoutExecutor:
 
     async def _run(self) -> None:
         idle_backoff = 0.001
+        clock = self.clock
         while True:
+            w0 = clock()
             await self._slot.wait()
             if not self._active:
                 await asyncio.sleep(0.05)
+                self.wait_s += clock() - w0
                 continue
+            self.wait_s += clock() - w0
+            b0 = clock()
             try:
                 emitted = await self.spout.next_tuple()
             except asyncio.CancelledError:
@@ -289,10 +320,18 @@ class SpoutExecutor:
                 self.n_errors += 1
                 self.rt.report_error(self.component_id, self.task_index, e)
                 emitted = False
+            finally:
+                dt = clock() - b0
             if not emitted:
+                # An empty poll is idle time, not work: a drained spout
+                # keeps calling next_tuple yet must read capacity ~0.
+                self.wait_s += dt
+                s0 = clock()
                 await asyncio.sleep(idle_backoff)
+                self.wait_s += clock() - s0
                 idle_backoff = min(idle_backoff * 2, 0.05)
             else:
+                self.busy_s += dt
                 idle_backoff = 0.001
 
     async def stop(self) -> None:

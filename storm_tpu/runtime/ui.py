@@ -23,6 +23,8 @@ Read routes
     GET /api/v1/topology/{name}/flight        flight-recorder events only
     GET /api/v1/topology/{name}/qos           admission/shed state
     GET /api/v1/topology/{name}/cascade       per-tier engines + escalation
+    GET /api/v1/topology/{name}/bottleneck    per-component utilization +
+                                              ranked bottleneck verdict
     GET /metrics                              Prometheus text exposition
 
 Admin routes (POST, like Storm UI's topology actions)
@@ -462,6 +464,21 @@ class UIServer:
                 else:
                     snap = await asyncio.to_thread(rt.metrics.snapshot)
                     out["slo"] = snap.get("slo", {})
+                return 200, out
+            if action == "bottleneck" and method == "GET":
+                # Where is the topology limited right now? Local runtimes
+                # answer from the attached Observatory's control loop —
+                # its last verdict, not a fresh sample (sampling here
+                # would race the loop's windowed cursors). Dist views
+                # answer with controller-merged per-worker utilization.
+                if hasattr(rt, "bottleneck"):  # DistRuntimeView
+                    return 200, await rt.bottleneck()
+                obs = getattr(rt, "obs", None)
+                if obs is None:
+                    return 404, {"error": "no observatory attached "
+                                          "(obs.enabled=false?)"}
+                out = {"topology": rt.name}
+                out.update(await asyncio.to_thread(obs.bottleneck_snapshot))
                 return 200, out
             if method != "POST":
                 return 405, {"error": "topology actions are POST"}

@@ -126,3 +126,35 @@ def test_slo_burn_artifact_gates():
                for w in art["timeline"])
     assert art["capture_session"].startswith("cap-")
     assert art["code_version"]
+
+
+def test_bottleneck_artifact_gates():
+    """BENCH_BOTTLENECK_r12.json backs the round-12 observatory docs:
+    the attributor named the induced limiter in BOTH arms (majority of
+    live /bottleneck route samples mid-drain), the sampling layer's
+    interleaved on/off A/B sits within the 2% bar, and the dist probe
+    got controller-merged windowed utilization with each component
+    attributed to its hosting worker."""
+    import json
+
+    art = json.loads((REPO / "BENCH_BOTTLENECK_r12.json").read_text())
+    assert art["metric"] == "bottleneck_attribution_arms_correct"
+    assert art["value"] == 2
+    assert art["attribution_ok"] is True
+    by_arm = {a["arm"]: a for a in art["arms"]}
+    assert by_arm["bn-infer"]["named"] == "inference-bolt"
+    assert by_arm["bn-spout"]["named"] == "kafka-spout"
+    for a in by_arm.values():
+        assert a["correct"] is True and a["drained"] is True
+        assert a["leader_votes"][a["named"]] >= 1
+    assert art["overhead_ok"] is True
+    assert art["overhead_pct"] <= 2.0
+    assert art["obs_on"]["samples"] and art["obs_off"]["samples"]
+    dist = art["dist_utilization"]
+    assert art["dist_utilization_ok"] is True and dist["ok"] is True
+    assert dist["first_call_primed_empty"] is True
+    assert dist["merged"]["kafka-spout"]["workers"] == [0]
+    assert dist["merged"]["inference-bolt"]["workers"] == [1]
+    assert dist["merged"]["inference-bolt"]["busy_s"] > 0.0
+    assert art["capture_session"].startswith("cap-")
+    assert art["code_version"]
