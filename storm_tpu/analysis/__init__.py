@@ -1,0 +1,25 @@
+"""storm-tpu's project-specific static analyzer (``storm-tpu lint``).
+
+Four invariant checkers over the package's own AST — lock discipline
+(LCK001/LCK002), exactly-once tuple handling (XO001), jit tracer hygiene
+(JIT001-004), and observability hygiene (OBS001-003) — gated in tier-1
+against the committed ``analysis/baseline.json``. See
+docs/ARCHITECTURE.md "Statically checked invariants" and the
+docs/OPERATIONS.md runbook.
+
+Kept import-light: ``runtime/metrics.py`` imports
+``storm_tpu.analysis.metric_names`` on the hot path at registry-creation
+time, so this module must not pull in the checkers.
+"""
+
+from storm_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintConfig,
+    RULES,
+    filter_new,
+    lint_source,
+    load_baseline,
+    load_config,
+    run_lint,
+    write_baseline,
+)

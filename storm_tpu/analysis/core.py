@@ -1,0 +1,401 @@
+"""Shared machinery for the ``storm-tpu lint`` invariant analyzer.
+
+The analyzer is project-specific by design: instead of a generic linter's
+style rules, each checker encodes one invariant the runtime's correctness
+actually rests on (lock discipline, the exactly-once ack contract, jit
+tracer hygiene, metric-name/span integrity — see docs/ARCHITECTURE.md
+"Statically checked invariants"). Checkers are pure AST passes: no imports
+of the checked code, so linting never executes device or network paths.
+
+Findings are gated against a committed ``baseline.json`` of reviewed-and-
+accepted findings (each with a one-line justification), so the tier-1 gate
+is "no NEW findings" — the analyzer can be adopted on a living tree without
+first refactoring every intentional lock-hold. Baseline keys deliberately
+exclude line numbers: editing an unrelated part of a file must not churn
+the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Rule id -> short description (the CLI's --rules help and the docs table
+#: derive from this; checkers register themselves via CHECKERS below).
+RULES: Dict[str, str] = {
+    "LCK001": "blocking call while a lock is held",
+    "LCK002": "lock-order inversion between acquisition sites",
+    "XO001": "tuple can leave execute() without ack/fail/deferral",
+    "JIT001": "np.* applied to a traced argument inside jit",
+    "JIT002": "Python control flow branches on a tracer value",
+    "JIT003": "clock/RNG read inside a jit-compiled function",
+    "JIT004": "host sync (block_until_ready/.item) inside jit",
+    "OBS001": "metric name not in the generated registry",
+    "OBS002": "unbalanced span/trace capture (start without stop)",
+    "OBS003": "metric name used as conflicting kinds",
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    scope: str  # dotted: Class.method or function or <module>
+    message: str
+    hint: str = ""
+    #: Stable detail token for baseline keying (e.g. the offending call
+    #: text) — survives line drift from unrelated edits.
+    detail: str = ""
+
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.scope}:{self.detail}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "description": RULES.get(self.rule, ""),
+            "path": self.path,
+            "line": self.line,
+            "scope": self.scope,
+            "message": self.message,
+            "hint": self.hint,
+            "key": self.key(),
+        }
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: {self.rule} [{self.scope}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+@dataclass
+class LintConfig:
+    """Knobs from ``[tool.storm-tpu.lint]`` in pyproject.toml.
+
+    ``exclude`` patterns are fnmatch globs against the repo-relative path;
+    per-rule excludes live in ``rule_exclude`` ({rule: [globs]}).
+    ``blocking_methods`` extends the built-in blocking-call table with
+    project-specific method names (e.g. the gRPC control-plane verbs) —
+    the attr name alone matches, so keep the list specific."""
+
+    enable: List[str] = field(default_factory=lambda: sorted(RULES))
+    exclude: List[str] = field(default_factory=list)
+    rule_exclude: Dict[str, List[str]] = field(default_factory=dict)
+    blocking_methods: List[str] = field(default_factory=list)
+    #: substrings identifying tuple-handling classes for the XO checker
+    tuple_classes: List[str] = field(
+        default_factory=lambda: ["Bolt", "Spout", "Sink", "Router",
+                                 "Operator"])
+
+    def rule_enabled(self, rule: str) -> bool:
+        return rule in self.enable
+
+    def excluded(self, rule: str, path: str) -> bool:
+        pats = list(self.exclude) + list(self.rule_exclude.get(rule, []))
+        return any(fnmatch.fnmatch(path, p) for p in pats)
+
+
+def _read_lint_section(path: str) -> dict:
+    """``[tool.storm-tpu.lint]`` as a dict. Uses tomllib when available
+    (3.11+); otherwise a minimal fallback that understands the subset this
+    section uses (string-list and string values), since the container's
+    3.10 has no TOML parser in the stdlib."""
+    try:
+        import tomllib  # type: ignore[import-not-found]
+    except ImportError:
+        tomllib = None
+    if tomllib is not None:
+        try:
+            with open(path, "rb") as f:
+                data = tomllib.load(f)
+        except (OSError, ValueError):
+            return {}
+        sec = data.get("tool", {}).get("storm-tpu", {}).get("lint", {})
+        return sec if isinstance(sec, dict) else {}
+    import re
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return {}
+    m = re.search(r"^\[tool\.(?:\"storm-tpu\"|storm-tpu)\.lint\]\s*$(.*?)"
+                  r"(?=^\[|\Z)", text, re.M | re.S)
+    if not m:
+        return {}
+    body = m.group(1)
+    out: dict = {}
+    # join multiline arrays, then parse `key = value` pairs
+    body = re.sub(r",\s*\n", ", ", body)
+    body = re.sub(r"\[\s*\n", "[", body)
+    body = re.sub(r"\n\s*\]", "]", body)
+    for line in body.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip()
+        if val.startswith("["):
+            out[key] = re.findall(r"\"([^\"]*)\"|'([^']*)'", val)
+            out[key] = [a or b for a, b in out[key]]
+        elif val[:1] in ("\"", "'"):
+            out[key] = val[1:-1]
+    return out
+
+
+def load_config(root: str) -> LintConfig:
+    """Read ``[tool.storm-tpu.lint]`` from ``<root>/pyproject.toml``;
+    missing file or section yields the defaults."""
+    cfg = LintConfig()
+    sec = _read_lint_section(os.path.join(root, "pyproject.toml"))
+    if not sec:
+        return cfg
+    if isinstance(sec.get("enable"), list):
+        cfg.enable = [str(r) for r in sec["enable"]]
+    if isinstance(sec.get("disable"), list):
+        cfg.enable = [r for r in cfg.enable
+                      if r not in {str(x) for x in sec["disable"]}]
+    if isinstance(sec.get("exclude"), list):
+        cfg.exclude = [str(p) for p in sec["exclude"]]
+    if isinstance(sec.get("blocking_methods"), list):
+        cfg.blocking_methods = [str(m) for m in sec["blocking_methods"]]
+    if isinstance(sec.get("tuple_classes"), list):
+        cfg.tuple_classes = [str(c) for c in sec["tuple_classes"]]
+    for rule in RULES:
+        key = f"exclude_{rule}"
+        if isinstance(sec.get(key), list):
+            cfg.rule_exclude[rule] = [str(p) for p in sec[key]]
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Source model: one parsed file handed to every checker
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SourceFile:
+    path: str  # repo-relative, forward slashes
+    tree: ast.Module
+    source: str
+
+    def text_of(self, node: ast.AST) -> str:
+        try:
+            return ast.get_source_segment(self.source, node) or ""
+        except Exception:  # pragma: no cover - malformed positions
+            return ""
+
+
+def parse_source(source: str, path: str) -> Optional[SourceFile]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    return SourceFile(path=path.replace(os.sep, "/"), tree=tree,
+                      source=source)
+
+
+def iter_python_files(paths: Sequence[str], root: str) -> Iterable[str]:
+    """Yield .py files under ``paths`` (files or directories), sorted,
+    skipping caches. Paths are returned repo-relative to ``root``."""
+    seen = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            seen.append(ap)
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    seen.append(os.path.join(dirpath, fn))
+    for ap in sorted(seen):
+        yield os.path.relpath(ap, root).replace(os.sep, "/")
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by checkers
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains; '' for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def last_segment(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor tracking the dotted Class.method scope for findings."""
+
+    def __init__(self) -> None:
+        self._scope: List[str] = []
+
+    @property
+    def scope(self) -> str:
+        return ".".join(self._scope) or "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def _visit_func(self, node) -> None:
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """``{finding key: justification}``. Accepts the committed schema
+    ({"findings": [{"key": ..., "why": ...}]}) and a bare key->why map."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if isinstance(data, dict) and isinstance(data.get("findings"), list):
+        out = {}
+        for row in data["findings"]:
+            if isinstance(row, dict) and row.get("key"):
+                out[str(row["key"])] = str(row.get("why", ""))
+        return out
+    if isinstance(data, dict):
+        return {str(k): str(v) for k, v in data.items()}
+    return {}
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   why: str = "accepted via --update-baseline",
+                   prior: Optional[Dict[str, str]] = None) -> None:
+    """Write the committed baseline, preserving prior justifications for
+    keys that survive."""
+    prior = prior or {}
+    rows = []
+    seen = set()
+    for f in sorted(findings, key=lambda f: f.key()):
+        if f.key() in seen:
+            continue  # several lines can share one key (same call, same
+        seen.add(f.key())  # scope); one entry suppresses them all
+        rows.append({
+            "key": f.key(),
+            "rule": f.rule,
+            "path": f.path,
+            "scope": f.scope,
+            "why": prior.get(f.key(), why),
+        })
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"findings": rows}, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def filter_new(findings: Sequence[Finding],
+               baseline: Dict[str, str]) -> List[Finding]:
+    return [f for f in findings if f.key() not in baseline]
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def lint_source(source: str, path: str = "<string>",
+                config: Optional[LintConfig] = None) -> List[Finding]:
+    """Lint one source string (the unit-test entry point)."""
+    sf = parse_source(source, path)
+    if sf is None:
+        return [Finding(rule="PARSE", path=path, line=1, scope="<module>",
+                        message="file does not parse", detail="syntax")]
+    return _check_file(sf, config or LintConfig())
+
+
+def _load_files(paths: Sequence[str], root: str
+                ) -> Tuple[List[SourceFile], List[Finding]]:
+    files: List[SourceFile] = []
+    findings: List[Finding] = []
+    for rel in iter_python_files(paths, root):
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        sf = parse_source(src, rel)
+        if sf is None:
+            findings.append(Finding(
+                rule="PARSE", path=rel, line=1, scope="<module>",
+                message="file does not parse", detail="syntax"))
+            continue
+        files.append(sf)
+    return files, findings
+
+
+def _check_file(sf: SourceFile, config: LintConfig) -> List[Finding]:
+    # Imported here so each checker module can use core helpers freely.
+    from storm_tpu.analysis import exactly_once, jit_hygiene, locks
+    from storm_tpu.analysis import observability
+
+    out: List[Finding] = []
+    for checker in (locks.check, exactly_once.check, jit_hygiene.check,
+                    observability.check):
+        for f in checker(sf, config):
+            if config.rule_enabled(f.rule) and not config.excluded(
+                    f.rule, f.path):
+                out.append(f)
+    return out
+
+
+def cross_file_findings(files: Sequence[SourceFile],
+                        config: LintConfig) -> List[Finding]:
+    """Whole-tree passes that need every file at once: the lock-order
+    inversion graph (LCK002) and metric kind conflicts (OBS003)."""
+    from storm_tpu.analysis import locks, observability
+
+    out: List[Finding] = []
+    for f in locks.check_ordering(files, config):
+        if config.rule_enabled(f.rule) and not config.excluded(f.rule, f.path):
+            out.append(f)
+    for f in observability.check_kinds(files, config):
+        if config.rule_enabled(f.rule) and not config.excluded(f.rule, f.path):
+            out.append(f)
+    return out
+
+
+def run_lint(paths: Sequence[str], root: str,
+             config: Optional[LintConfig] = None) -> List[Finding]:
+    """Full run: per-file checkers plus the cross-file graph passes."""
+    config = config or load_config(root)
+    files, findings = _load_files(paths, root)
+    for sf in files:
+        findings.extend(_check_file(sf, config))
+    findings.extend(cross_file_findings(files, config))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,251 @@
+"""XO001: exactly-once tuple discipline.
+
+Every tuple that enters a bolt/router/operator ``execute`` path must leave
+it **owned by someone**: acked, failed, handed to a deferral registry
+(pending batch, residue buffer, replay queue), emitted as an anchor, or
+raised through to the executor (``BoltExecutor._run`` catches execute
+exceptions and calls ``collector.fail(t)`` — so a raise IS a handled path).
+A tuple that simply falls off the end of a control-flow path is a tuple
+the ledger will wait on forever — exactly the silent-drop class the
+cascade/continuous replay code re-implements deferral to avoid.
+
+The checker walks the method body as a small path-sensitive CFG:
+
+* "handled" events: ``*.ack(t)`` / ``*.fail(t)``; ``t`` passed to any
+  non-predicate call (ownership transfer — ``self._pending.append(t)``,
+  ``self.emit(row, anchor=t)``, ``registry.defer(t)``); ``t`` stored into
+  an attribute or container; ``return t``.
+* calls in **test position** (``if is_tick(t):``) do NOT count — reading a
+  tuple is not owning it. Neither do attribute reads (``t.values``).
+* ``raise`` ends a path as handled (executor fails the tuple).
+* ``try/finally`` is finally-aware: a ``finally`` block that always
+  handles the tuple rescues every path through the try, including early
+  returns and exception edges. ``except`` handlers enter with the state
+  from try *entry* (the conservative choice — the handler can run before
+  any try-body handling happened).
+
+Only methods named ``execute``/``process``/``drain`` on classes whose name
+matches ``[tool.storm-tpu.lint] tuple_classes`` are checked; abstract
+bodies (docstring/pass/ellipsis only) are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set, Tuple
+
+from storm_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    SourceFile,
+    dotted_name,
+    last_segment,
+)
+
+_METHODS = ("execute", "process", "drain")
+
+#: call names (last segment) that merely *read* the tuple — passing t to
+#: these is not an ownership transfer
+_PREDICATES = {"is_tick", "isinstance", "len", "repr", "str", "id", "type",
+               "bool", "hash", "getattr", "hasattr", "print", "format"}
+
+
+def _is_abstract(body: Sequence[ast.stmt]) -> bool:
+    for st in body:
+        if isinstance(st, ast.Pass):
+            continue
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Constant):
+            continue  # docstring / Ellipsis
+        if isinstance(st, ast.Raise):
+            continue  # raise NotImplementedError
+        return False
+    return True
+
+
+def _mentions(node: ast.AST, var: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == var
+               for n in ast.walk(node))
+
+
+def _call_handles(call: ast.Call, var: str) -> bool:
+    """Does this call take ownership of ``var``?"""
+    if not _mentions(call, var):
+        return False
+    fn = last_segment(dotted_name(call.func))
+    if fn in ("ack", "fail"):
+        return True
+    if fn in _PREDICATES or fn.startswith(("is_", "has_")):
+        return False
+    return True
+
+
+def _expr_handles(node: ast.AST, var: str) -> bool:
+    """Any ownership-transfer event for ``var`` inside ``node`` (which must
+    not be a test-position expression — callers exclude those)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return False  # deferred execution; too clever — don't credit
+        if isinstance(sub, ast.Call) and _call_handles(sub, var):
+            return True
+        if isinstance(sub, ast.Assign):
+            if isinstance(sub.value, ast.Name) and sub.value.id == var:
+                for tgt in sub.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        return True
+            # self.x = (t, meta) / buf[k] = [t, ...]
+            elif _mentions(sub.value, var):
+                for tgt in sub.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        return True
+    return False
+
+
+class _Flow:
+    """Path walk over one method body.
+
+    States are booleans ("tuple handled so far"); a statement list maps an
+    in-state set to a fall-through state set, recording every exit
+    (return / implicit end) that can happen while unhandled."""
+
+    def __init__(self, var: str) -> None:
+        self.var = var
+        #: (line, kind) of unhandled exits
+        self.bad: List[Tuple[int, str]] = []
+
+    def walk(self, stmts: Sequence[ast.stmt],
+             states: Set[bool]) -> Set[bool]:
+        cur = set(states)
+        for st in stmts:
+            if not cur:
+                break  # unreachable after return/raise on all paths
+            cur = self._stmt(st, cur)
+        return cur
+
+    def _stmt(self, st: ast.stmt, states: Set[bool]) -> Set[bool]:
+        v = self.var
+        if isinstance(st, ast.Return):
+            if st.value is not None and (
+                    (isinstance(st.value, ast.Name) and st.value.id == v)
+                    or _expr_handles(st.value, v)):
+                return set()  # return t / return self._defer(t)
+            if False in states:
+                self.bad.append((st.lineno, "return"))
+            return set()
+        if isinstance(st, ast.Raise):
+            return set()  # executor fails the tuple
+        if isinstance(st, ast.If):
+            # test position never handles
+            out = self.walk(st.body, states)
+            out |= self.walk(st.orelse, states)
+            return out
+        if isinstance(st, (ast.While, ast.For, ast.AsyncFor)):
+            body_out = self.walk(st.body, states)
+            out = set(states) | body_out  # zero or more iterations
+            out |= self.walk(st.orelse, out)
+            return out
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            entry = set(states)
+            for item in st.items:
+                if _expr_handles(item.context_expr, v):
+                    entry = {True}
+            return self.walk(st.body, entry)
+        if isinstance(st, ast.Try):
+            return self._try(st, states)
+        if isinstance(st, ast.Match):
+            out: Set[bool] = set()
+            exhaustive = False
+            for case in st.cases:
+                out |= self.walk(case.body, states)
+                if isinstance(case.pattern, ast.MatchAs) \
+                        and case.pattern.pattern is None:
+                    exhaustive = True  # case _:
+            if not exhaustive:
+                out |= states
+            return out
+        if isinstance(st, (ast.Break, ast.Continue)):
+            # approximate: treat as falling through with current state —
+            # the loop join above already unions body states in
+            return states
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return states
+        # simple statement
+        if _expr_handles(st, v):
+            return {True}
+        return states
+
+    def _try(self, st: ast.Try, states: Set[bool]) -> Set[bool]:
+        v = self.var
+        # Does finally unconditionally handle? Then it rescues everything
+        # that happens inside the try: exception edges, early returns, and
+        # plain falls all pass through it.
+        rescued = False
+        if st.finalbody:
+            probe = _Flow(v)
+            if probe.walk(st.finalbody, {False}) == {True} and not probe.bad:
+                rescued = True
+        if rescued:
+            # run sub-walks only for nested findings *outside* this try's
+            # responsibility — everything tuple-related is rescued, so
+            # discard their bad exits.
+            sub = _Flow(v)
+            sub.walk(st.body, states)
+            for h in st.handlers:
+                sub.walk(h.body, states)
+            sub.walk(st.orelse, {True})
+            return {True}
+        body_out = self.walk(st.body, states)
+        out = set(body_out)
+        for h in st.handlers:
+            # conservative: the handler may run before any try-body
+            # handling happened
+            out |= self.walk(h.body, states)
+        if st.orelse:
+            out = self.walk(st.orelse, body_out) | (out - body_out)
+        if st.finalbody:
+            out = self.walk(st.finalbody, out or states)
+        return out
+
+
+def check(sf: SourceFile, config: LintConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        if not any(tag in cls.name for tag in config.tuple_classes):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name not in _METHODS:
+                continue
+            args = fn.args.args
+            if len(args) < 2:
+                continue  # no tuple parameter (tick-style)
+            if _is_abstract(fn.body):
+                continue
+            var = args[1].arg
+            flow = _Flow(var)
+            fall = flow.walk(fn.body, {False})
+            exits = list(flow.bad)
+            if False in fall:
+                exits.append((fn.body[-1].end_lineno or fn.lineno, "end"))
+            for i, (line, kind) in enumerate(exits):
+                where = ("falls off the end of" if kind == "end"
+                         else "returns from")
+                findings.append(Finding(
+                    rule="XO001",
+                    path=sf.path,
+                    line=line,
+                    scope=f"{cls.name}.{fn.name}",
+                    message=(f"tuple '{var}' can reach this point "
+                             f"unhandled ({where} {fn.name} without "
+                             "ack/fail/defer)"),
+                    hint=("ack/fail the tuple, hand it to a deferral "
+                          "registry, or raise — on every path including "
+                          "except edges; a finally that always defers "
+                          "also satisfies the contract"),
+                    detail=f"{var}:{kind}:{i}",
+                ))
+    return findings

@@ -1,0 +1,256 @@
+"""JIT001-004: tracer hygiene for jit-compiled functions.
+
+Functions handed to ``jax.jit``/``pjit`` are *traced*: their Python body
+runs once with abstract values, and anything that escapes the tracer —
+``np.*`` on a traced array, ``if`` on a tracer, a clock read, a host sync —
+either raises ``TracerError`` at first dispatch or, worse, silently bakes
+a trace-time constant into the compiled graph (the clock/RNG case). The
+engine's dispatch path stays async only because the jitted forward never
+blocks on the host; these rules keep it that way.
+
+Jit targets are found three ways: ``@jax.jit`` / ``@pjit`` decorators,
+``@functools.partial(jax.jit, static_argnames=...)`` decorators, and
+``jax.jit(fn, ...)`` call sites where ``fn`` resolves to a def in the same
+file (the engine's closure-built ``fwd``). Params named in
+``static_argnames``/``static_argnums`` are concrete at trace time and are
+exempt from taint.
+
+* **JIT001** — ``np.*``/``numpy.*`` applied to a traced argument (use
+  ``jnp``; numpy forces a host round-trip or a TracerError).
+* **JIT002** — ``if``/``while``/ternary/assert branching on a tracer (use
+  ``jnp.where`` / ``lax.cond``; Python control flow burns the branch into
+  the trace).
+* **JIT003** — clock or RNG read (``time.*``, ``random.*``,
+  ``np.random.*``, ``datetime.now``) anywhere in a jitted body: the value
+  freezes at trace time, so every later call replays it.
+* **JIT004** — host sync (``.block_until_ready()``, ``.item()``,
+  ``jax.device_get``, ``float()/int()/bool()`` of a tracer) inside the
+  traced body.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from storm_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    SourceFile,
+    dotted_name,
+    last_segment,
+)
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+_CLOCKS = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.time_ns", "time.monotonic_ns",
+    "datetime.now", "datetime.datetime.now", "datetime.utcnow",
+}
+
+_RNG_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+_SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+
+#: attributes of a traced array that are *concrete* at trace time — values
+#: derived from them are ordinary Python scalars, so branching on them or
+#: asserting about them is fine (shape polymorphism is not in play here)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding"}
+
+
+def _is_jit_expr(node: ast.AST) -> Tuple[bool, Set[str], Set[int]]:
+    """Is ``node`` a jit/pjit (possibly partial-wrapped) expression?
+    Returns (is_jit, static_argnames, static_argnums)."""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return dotted_name(node) in _JIT_NAMES, set(), set()
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn in _JIT_NAMES:
+            return True, _static_names(node), _static_nums(node)
+        if last_segment(fn) == "partial" and node.args:
+            inner = dotted_name(node.args[0])
+            if inner in _JIT_NAMES:
+                return True, _static_names(node), _static_nums(node)
+    return False, set(), set()
+
+
+def _static_names(call: ast.Call) -> Set[str]:
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+    return out
+
+
+def _static_nums(call: ast.Call) -> Set[int]:
+    out: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    out.add(n.value)
+    return out
+
+
+def _collect_targets(sf: SourceFile):
+    """Yield (funcdef, static_names, static_nums, class_scope) for every
+    jit-compiled function in the file."""
+    # index every def by name within its immediate parent, for resolving
+    # jax.jit(fwd) call sites
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(sf.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    def scope_of(node: ast.AST) -> str:
+        chain: List[str] = []
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.ClassDef, ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                chain.append(cur.name)
+            cur = parents.get(cur)
+        return ".".join(reversed(chain))
+
+    seen: Set[ast.AST] = set()
+    # decorator form
+    for name, defs in defs_by_name.items():
+        for fd in defs:
+            for dec in getattr(fd, "decorator_list", []):
+                is_jit, snames, snums = _is_jit_expr(dec)
+                if is_jit and fd not in seen:
+                    seen.add(fd)
+                    yield fd, snames, snums, scope_of(fd)
+    # call form: jax.jit(fn, ...)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn_name = dotted_name(node.func)
+        if fn_name not in _JIT_NAMES or not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Name) and target.id in defs_by_name:
+            for fd in defs_by_name[target.id]:
+                if fd not in seen:
+                    seen.add(fd)
+                    yield (fd, _static_names(node), _static_nums(node),
+                           scope_of(fd))
+
+
+def _tainted_params(fd, static_names: Set[str],
+                    static_nums: Set[int]) -> Set[str]:
+    params = [a.arg for a in fd.args.args]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+        offset = 1
+    else:
+        offset = 0
+    out = set()
+    for i, p in enumerate(params):
+        if p in static_names or (i + offset) in static_nums or i in static_nums:
+            continue
+        out.add(p)
+    return out
+
+
+def check(sf: SourceFile, config: LintConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    for fd, snames, snums, cls_scope in _collect_targets(sf):
+        scope = f"{cls_scope}.{fd.name}" if cls_scope else fd.name
+        tainted = _tainted_params(fd, snames, snums)
+        findings.extend(_check_body(sf, fd, tainted, scope))
+    return findings
+
+
+def _check_body(sf: SourceFile, fd, tainted: Set[str],
+                scope: str) -> List[Finding]:
+    findings: List[Finding] = []
+    taint = set(tainted)
+
+    def is_tainted(node: ast.AST) -> bool:
+        # prune subtrees that are concrete at trace time: x.shape, x.dtype,
+        # len(x) — a value computed from those is a Python scalar
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return False
+        if isinstance(node, ast.Call) and \
+                last_segment(dotted_name(node.func)) == "len":
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in taint
+        return any(is_tainted(c) for c in ast.iter_child_nodes(node))
+
+    def add(rule: str, node: ast.AST, message: str, hint: str,
+            detail: str) -> None:
+        findings.append(Finding(
+            rule=rule, path=sf.path, line=node.lineno, scope=scope,
+            message=message, hint=hint, detail=detail))
+
+    for node in ast.walk(fd):
+        # taint propagation through straight-line assignment (order of
+        # ast.walk is pre-order, good enough for the simple bodies here)
+        if isinstance(node, ast.Assign) and is_tainted(node.value):
+            for tgt in node.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        taint.add(n.id)
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name.startswith(("np.", "numpy.")) and \
+                    not name.startswith(_RNG_PREFIXES):
+                if any(is_tainted(a) for a in node.args) or \
+                        any(is_tainted(k.value) for k in node.keywords):
+                    add("JIT001", node,
+                        f"{name}() applied to traced argument inside "
+                        f"jit-compiled {fd.name}",
+                        "use the jnp equivalent; numpy on a tracer raises "
+                        "or forces a host transfer", name)
+            if name in _CLOCKS or name.startswith(_RNG_PREFIXES):
+                add("JIT003", node,
+                    f"{name}() inside jit-compiled {fd.name} freezes its "
+                    "value at trace time",
+                    "pass the value in as an argument, or use "
+                    "jax.random with an explicit key", name)
+            if name == "jax.device_get":
+                add("JIT004", node,
+                    f"jax.device_get inside jit-compiled {fd.name}",
+                    "return the array and fetch it outside the jitted "
+                    "function", name)
+            if name in ("float", "int", "bool") and node.args and \
+                    is_tainted(node.args[0]):
+                add("JIT004", node,
+                    f"{name}() of a traced value inside jit-compiled "
+                    f"{fd.name} forces a host sync",
+                    "keep the value on-device (jnp ops) or hoist the "
+                    "conversion out of the jitted function", name)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_METHODS and \
+                    is_tainted(node.func.value):
+                add("JIT004", node,
+                    f".{node.func.attr}() on a traced value inside "
+                    f"jit-compiled {fd.name}",
+                    "host syncs belong outside the traced body",
+                    node.func.attr)
+        if isinstance(node, (ast.If, ast.While)) and is_tainted(node.test):
+            add("JIT002", node,
+                f"Python control flow branches on a traced value in "
+                f"jit-compiled {fd.name}",
+                "use jnp.where or jax.lax.cond/switch; Python if/while "
+                "bakes one branch into the trace",
+                "branch")
+        if isinstance(node, ast.IfExp) and is_tainted(node.test):
+            add("JIT002", node,
+                f"conditional expression tests a traced value in "
+                f"jit-compiled {fd.name}",
+                "use jnp.where(cond, a, b)", "ifexp")
+        if isinstance(node, ast.Assert) and is_tainted(node.test):
+            add("JIT002", node,
+                f"assert on a traced value in jit-compiled {fd.name}",
+                "use checkify or move the check outside the trace",
+                "assert")
+    return findings

@@ -1,0 +1,344 @@
+"""LCK001/LCK002: lock-discipline checkers.
+
+The runtime mixes asyncio executors with real threads (engine fetch thread,
+continuous-batching dispatcher, dist transport, Kafka wire client), all
+coordinated by ``threading.Lock``/``Condition``. Two bug classes keep
+reappearing in review:
+
+* **LCK001 — blocking call under a lock.** A thread sleeping, joining,
+  waiting on a Future, doing socket I/O, or forcing a device sync while it
+  holds a lock stalls every other thread that needs that lock; under the
+  client-wide locks (KafkaWireClient, shared_engine) that is a global stall.
+  Condition ``wait``/``wait_for`` on the *held* condition is exempt — it
+  releases the lock while sleeping, which is the whole point of a Condition.
+
+* **LCK002 — lock-order inversion.** Two sites that acquire the same pair
+  of locks in opposite orders can deadlock. The checker builds an
+  acquisition graph over the whole tree (lock identities are
+  ``module:Class.attr`` for instance locks, ``module:NAME`` for globals)
+  and flags every 2-cycle.
+
+Both are heuristic AST passes: lock-ness is inferred from names
+(``*lock*``, ``*cond*``, ``mutex``, ``*sem*``) plus ``.acquire()`` calls,
+and blocking-ness from a call table extended by ``[tool.storm-tpu.lint]
+blocking_methods``. Intentional holds (e.g. the engine's device dispatch
+under ``_lock`` to preserve collective ordering) go in the baseline with a
+justification, not in code-level suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from storm_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    SourceFile,
+    dotted_name,
+    last_segment,
+)
+
+#: fully-dotted callables that block the calling thread
+BLOCKING_FUNCS = {
+    "time.sleep",
+    "select.select",
+    "socket.create_connection",
+    "jax.device_put",
+    "jax.device_get",
+    "subprocess.run",
+    "subprocess.check_output",
+}
+
+#: method names that block regardless of receiver
+BLOCKING_METHODS = {
+    "recv", "recv_into", "accept", "connect", "sendall", "makefile",
+    "block_until_ready", "result",
+}
+
+#: base-name fragments that mark a receiver as a queue (so zero-positional
+#: ``.get()`` / ``.put(...)`` mean the blocking queue protocol, not dict.get)
+_QUEUEISH = ("queue", "inbox", "outbox", "mailbox")
+
+
+def _module_of(path: str) -> str:
+    mod = path[:-3] if path.endswith(".py") else path
+    return mod.replace("/", ".")
+
+
+def _is_lockish(name: str) -> bool:
+    seg = last_segment(name).lower()
+    return bool(seg) and ("lock" in seg or "cond" in seg or seg == "mutex"
+                          or "sem" in seg)
+
+
+def _queueish(base: str) -> bool:
+    seg = last_segment(base).lower()
+    return (any(q in seg for q in _QUEUEISH) or seg in ("q",)
+            or seg.endswith("_q"))
+
+
+class _Region:
+    """One lock-held region: identity key + acquisition site."""
+
+    __slots__ = ("key", "line")
+
+    def __init__(self, key: str, line: int) -> None:
+        self.key = key
+        self.line = line
+
+
+class _LockWalker:
+    """Per-file walk producing LCK001 findings and acquisition edges."""
+
+    def __init__(self, sf: SourceFile, config: LintConfig) -> None:
+        self.sf = sf
+        self.config = config
+        self.module = _module_of(sf.path)
+        self.findings: List[Finding] = []
+        #: (outer_key, inner_key, path, line, scope)
+        self.edges: List[Tuple[str, str, str, int, str]] = []
+        self._class_stack: List[str] = []
+        self._func_stack: List[str] = []
+
+    # -- identity ---------------------------------------------------------
+
+    def _lock_key(self, expr: ast.AST) -> Optional[str]:
+        name = dotted_name(expr)
+        if not name or not _is_lockish(name):
+            return None
+        if name.startswith("self."):
+            cls = self._class_stack[-1] if self._class_stack else "?"
+            return f"{self.module}:{cls}.{name[5:]}"
+        if "." not in name:
+            # module global (typically ALL_CAPS) unifies across functions;
+            # a function-local lock object is scoped to its function.
+            if name.isupper() or not self._func_stack:
+                return f"{self.module}:{name}"
+            return f"{self.module}:{'.'.join(self._func_stack)}#{name}"
+        return f"{self.module}:{name}"
+
+    @property
+    def scope(self) -> str:
+        return ".".join(self._class_stack + self._func_stack) or "<module>"
+
+    # -- traversal --------------------------------------------------------
+
+    def run(self) -> None:
+        self._walk_body(self.sf.tree.body, [])
+
+    def _walk_body(self, stmts: Sequence[ast.stmt],
+                   held: List[_Region]) -> None:
+        i = 0
+        n = len(stmts)
+        while i < n:
+            st = stmts[i]
+            key = self._acquire_stmt(st)
+            if key is not None:
+                # linear-scan region: from this .acquire() to the matching
+                # .release() at the same nesting level (or end of body).
+                j = i + 1
+                while j < n and self._release_stmt(stmts[j]) != key:
+                    j += 1
+                self._enter(key, st.lineno, held)
+                region = _Region(key, st.lineno)
+                self._walk_body(list(stmts[i + 1:j]), held + [region])
+                i = j + 1
+                continue
+            self._walk_stmt(st, held)
+            i += 1
+
+    def _acquire_stmt(self, st: ast.stmt) -> Optional[str]:
+        if not (isinstance(st, ast.Expr) and isinstance(st.value, ast.Call)):
+            return None
+        call = st.value
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "acquire":
+            return self._lock_key(call.func.value)
+        return None
+
+    def _release_stmt(self, st: ast.stmt) -> Optional[str]:
+        if not (isinstance(st, ast.Expr) and isinstance(st.value, ast.Call)):
+            return None
+        call = st.value
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "release":
+            return self._lock_key(call.func.value)
+        return None
+
+    def _enter(self, key: str, line: int, held: List[_Region]) -> None:
+        for outer in held:
+            if outer.key != key:
+                self.edges.append(
+                    (outer.key, key, self.sf.path, line, self.scope))
+
+    def _walk_stmt(self, st: ast.stmt, held: List[_Region]) -> None:
+        if isinstance(st, ast.ClassDef):
+            self._class_stack.append(st.name)
+            self._walk_body(st.body, held)
+            self._class_stack.pop()
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def's body runs later, not under the current locks
+            self._func_stack.append(st.name)
+            self._walk_body(st.body, [])
+            self._func_stack.pop()
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            add: List[_Region] = []
+            for item in st.items:
+                expr = item.context_expr
+                key = None if isinstance(expr, ast.Call) \
+                    else self._lock_key(expr)
+                if key is not None:
+                    self._enter(key, st.lineno, held + add)
+                    add.append(_Region(key, st.lineno))
+                else:
+                    # with sock.makefile() as f: — a blocking item is a
+                    # blocking call like any other
+                    self._scan_expr(expr, held + add)
+            self._walk_body(st.body, held + add)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._scan_expr(st.test, held)
+            self._walk_body(st.body, list(held))
+            self._walk_body(st.orelse, list(held))
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._scan_expr(st.iter, held)
+            self._walk_body(st.body, list(held))
+            self._walk_body(st.orelse, list(held))
+            return
+        if isinstance(st, ast.Try):
+            self._walk_body(st.body, list(held))
+            for handler in st.handlers:
+                self._walk_body(handler.body, list(held))
+            self._walk_body(st.orelse, list(held))
+            self._walk_body(st.finalbody, list(held))
+            return
+        # simple statement: scan the whole thing
+        self._scan_expr(st, held)
+
+    # -- blocking-call detection ------------------------------------------
+
+    def _scan_expr(self, node: ast.AST, held: List[_Region]) -> None:
+        if not held:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Lambda):
+                continue  # runs later
+            if isinstance(sub, ast.Call):
+                self._check_call(sub, held)
+
+    def _check_call(self, call: ast.Call, held: List[_Region]) -> None:
+        reason = self._blocking_reason(call, held)
+        if reason is None:
+            return
+        innermost = held[-1]
+        self.findings.append(Finding(
+            rule="LCK001",
+            path=self.sf.path,
+            line=call.lineno,
+            scope=self.scope,
+            message=(f"blocking call {reason}() while holding "
+                     f"{innermost.key.split(':')[-1]} "
+                     f"(acquired line {innermost.line})"),
+            hint=("move the blocking call outside the lock (snapshot under "
+                  "the lock, act after releasing), or baseline with a "
+                  "justification if the hold is intentional"),
+            detail=reason,
+        ))
+
+    def _blocking_reason(self, call: ast.Call,
+                         held: List[_Region]) -> Optional[str]:
+        name = dotted_name(call.func)
+        if name in BLOCKING_FUNCS:
+            return name
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        meth = call.func.attr
+        base = dotted_name(call.func.value)
+        if meth in ("wait", "wait_for"):
+            # Condition.wait on a lock we hold RELEASES it — the sanctioned
+            # sleep-under-lock. Any other .wait (Event, Process, foreign
+            # condition) sleeps while still holding ours.
+            key = self._lock_key(call.func.value)
+            if key is not None and any(r.key == key for r in held):
+                return None
+            return f"{base or '?'}.{meth}"
+        if meth in BLOCKING_METHODS:
+            # .result() is the Future protocol everywhere in this tree;
+            # recv/sendall/accept/connect only appear on sockets.
+            return f"{base or '?'}.{meth}"
+        if meth == "join":
+            # zero-arg join is Thread/Process.join; sep.join(parts) and
+            # os.path.join always take arguments.
+            if not call.args and not call.keywords:
+                return f"{base or '?'}.join"
+            return None
+        if meth == "get":
+            kw = {k.arg for k in call.keywords}
+            if "timeout" in kw or "block" in kw:
+                return f"{base or '?'}.get"
+            if not call.args and _queueish(base):
+                return f"{base}.get"
+            return None
+        if meth == "put":
+            if _queueish(base):
+                for k in call.keywords:
+                    if k.arg == "block" and isinstance(k.value, ast.Constant) \
+                            and k.value.value is False:
+                        return None
+                return f"{base}.put"
+            return None
+        if meth == "acquire":
+            # acquiring a second lock is an LCK002 edge, not LCK001 —
+            # except semaphores, which can sleep indefinitely and are not
+            # part of an ordering discipline.
+            if "sem" in last_segment(base).lower():
+                return f"{base}.acquire"
+            return None
+        if meth in self.config.blocking_methods:
+            return f"{base or '?'}.{meth}"
+        return None
+
+
+def check(sf: SourceFile, config: LintConfig) -> List[Finding]:
+    w = _LockWalker(sf, config)
+    w.run()
+    return w.findings
+
+
+def collect_edges(sf: SourceFile, config: LintConfig):
+    w = _LockWalker(sf, config)
+    w.run()
+    return w.edges
+
+
+def check_ordering(files: Iterable[SourceFile],
+                   config: LintConfig) -> List[Finding]:
+    """LCK002: find 2-cycles in the whole-tree lock-acquisition graph."""
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for sf in files:
+        for outer, inner, path, line, scope in collect_edges(sf, config):
+            edges.setdefault((outer, inner), (path, line, scope))
+    findings: List[Finding] = []
+    seen = set()
+    for (a, b), (path, line, scope) in sorted(edges.items()):
+        if (b, a) not in edges or frozenset((a, b)) in seen:
+            continue
+        seen.add(frozenset((a, b)))
+        other_path, other_line, _ = edges[(b, a)]
+        findings.append(Finding(
+            rule="LCK002",
+            path=path,
+            line=line,
+            scope=scope,
+            message=(f"lock-order inversion: {a.split(':')[-1]} -> "
+                     f"{b.split(':')[-1]} here, but "
+                     f"{other_path}:{other_line} acquires them in the "
+                     "opposite order"),
+            hint=("pick one global order for this lock pair and make both "
+                  "sites follow it, or split the critical sections so "
+                  "neither nests"),
+            detail="<->".join(sorted((a, b))),
+        ))
+    return findings

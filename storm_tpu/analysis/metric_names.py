@@ -1,0 +1,133 @@
+"""Metric-name registry — GENERATED, do not edit by hand.
+
+Regenerate after adding/renaming a metric:
+
+    storm-tpu lint --regen-metric-registry
+
+Generated from every ``counter``/``gauge``/``histogram`` call site in the
+tree. Literal names land in ``METRIC_NAMES``; f-string sites contribute a
+wildcard pattern to ``METRIC_PATTERNS`` (literal chunks joined by ``*``).
+``storm_tpu/analysis/observability.py`` (OBS001) checks call sites against
+this file statically; ``runtime/metrics.py`` warns once at runtime for any
+name that matches neither — together they catch the write-side typo whose
+only other symptom is a flatlined dashboard panel.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+
+METRIC_NAMES = frozenset({
+    'ack_rate',
+    'acked',
+    'batch_fill',
+    'batch_size',
+    'batch_wait_ms',
+    'burn_rate',
+    'burn_rate_slow',
+    'cascade_budget_capped',
+    'cascade_escalations',
+    'cascade_shed_pinned',
+    'checkpoints',
+    'coalesced_sources',
+    'dead_lettered',
+    'delivered',
+    'device_ms',
+    'dispatch_wait_ms',
+    'dropped_stale',
+    'e2e_latency_ms',
+    'emitted',
+    'errors',
+    'escalation_rate',
+    'execute_ms',
+    'execute_rate',
+    'executed',
+    'executor_restarts',
+    'failed',
+    'inbox_depth',
+    'ingest_lag_ms',
+    'instances_inferred',
+    'produce_ms',
+    'profile_regressions',
+    'shed_decisions',
+    'shed_degraded',
+    'shed_level',
+    'shed_rejected',
+    'slo_breaches',
+    'tree_acked',
+    'tree_failed',
+    'tripped',
+    'txn_aborts',
+    'txn_commits',
+    'txn_offsets_deferred',
+})
+
+METRIC_PATTERNS = (
+    '*_ms',
+    'admitted_*',
+    'admitted_lane_*',
+    'cascade_accepted_tier*',
+    'cascade_decided_lane_*',
+    'cascade_escalated_lane_*',
+    'e2e_latency_ms_*',
+    'fair_rows_*_*',
+    'fair_starved_*_*',
+    'shed_*',
+    'shed_lane_*',
+    'throttled_*',
+    'throttled_lane_*',
+    'tier*_device_ms',
+)
+
+#: literal name -> kinds seen at generation time
+METRIC_KINDS = {
+    'ack_rate': ('gauge',),
+    'acked': ('counter',),
+    'batch_fill': ('histogram',),
+    'batch_size': ('histogram',),
+    'batch_wait_ms': ('histogram',),
+    'burn_rate': ('gauge',),
+    'burn_rate_slow': ('gauge',),
+    'cascade_budget_capped': ('counter',),
+    'cascade_escalations': ('counter',),
+    'cascade_shed_pinned': ('counter',),
+    'checkpoints': ('counter',),
+    'coalesced_sources': ('counter',),
+    'dead_lettered': ('counter',),
+    'delivered': ('counter',),
+    'device_ms': ('histogram',),
+    'dispatch_wait_ms': ('histogram',),
+    'dropped_stale': ('counter',),
+    'e2e_latency_ms': ('histogram',),
+    'emitted': ('counter',),
+    'errors': ('counter',),
+    'escalation_rate': ('gauge',),
+    'execute_ms': ('histogram',),
+    'execute_rate': ('gauge',),
+    'executed': ('counter',),
+    'executor_restarts': ('counter',),
+    'failed': ('counter',),
+    'inbox_depth': ('gauge',),
+    'ingest_lag_ms': ('histogram',),
+    'instances_inferred': ('counter',),
+    'produce_ms': ('histogram',),
+    'profile_regressions': ('counter',),
+    'shed_decisions': ('counter',),
+    'shed_degraded': ('counter',),
+    'shed_level': ('gauge',),
+    'shed_rejected': ('counter',),
+    'slo_breaches': ('counter',),
+    'tree_acked': ('counter',),
+    'tree_failed': ('counter',),
+    'tripped': ('gauge',),
+    'txn_aborts': ('counter',),
+    'txn_commits': ('counter',),
+    'txn_offsets_deferred': ('counter',),
+}
+
+
+def is_known(name: str) -> bool:
+    if name in METRIC_NAMES:
+        return True
+    return any(fnmatch.fnmatchcase(name, p)
+               for p in METRIC_PATTERNS)

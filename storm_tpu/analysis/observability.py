@@ -1,0 +1,257 @@
+"""OBS001-003: observability hygiene.
+
+The dashboards, the autoscaler, the shed controller, and the SLO burn
+monitor all read metrics *by name* (``e2e_latency_ms``, ``batch_wait_ms``,
+``slo_breaches``...). A typo in a writer site doesn't error — it creates a
+parallel, never-read series while the reader sees a flatline, which is the
+one failure mode a dashboard cannot display. So:
+
+* **OBS001** — every literal metric name in a ``counter``/``gauge``/
+  ``histogram`` call must appear in the generated registry
+  (``storm_tpu/analysis/metric_names.py``); f-string names must match one
+  of the registry's wildcard patterns. The registry is *generated from the
+  call sites themselves* (``storm-tpu lint --regen-metric-registry``), so
+  the check is "this name was seen when the registry was last reviewed",
+  i.e. new names show up as findings until the regen is committed.
+* **OBS002** — ``jax.profiler.start_trace`` without a ``stop_trace`` in
+  the same function leaks a device trace session (the sanctioned shape is
+  ``device_trace()``'s try/finally).
+* **OBS003** — (whole-tree) one metric name used as conflicting kinds
+  (counter in one module, histogram in another): the prometheus renderer
+  would emit the same family with two types.
+
+Name-variable call sites (``m.histogram(comp, key)`` with ``key`` looping
+over a dict) are skipped statically; the runtime registry warn-once in
+``runtime/metrics.py`` covers those.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from storm_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    ScopedVisitor,
+    SourceFile,
+    dotted_name,
+)
+
+_KINDS = ("counter", "gauge", "histogram")
+
+#: Minimum literal characters for a wildcard pattern to be used when
+#: validating *literal* names: f"{what}_{tenant}"-style sites generate
+#: patterns like ``*_*`` that would vacuously accept near-typos.
+_STRICT_PATTERN_MIN_LITERAL = 3
+
+
+def _name_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The metric-name argument of a registry call, or None."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    if call.func.attr not in _KINDS:
+        return None
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+def _pattern_of(js: ast.JoinedStr) -> str:
+    """fnmatch pattern for an f-string name: literal chunks joined by *."""
+    parts: List[str] = []
+    for v in js.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        else:
+            parts.append("*")
+    pat = "".join(parts)
+    while "**" in pat:
+        pat = pat.replace("**", "*")
+    return pat
+
+
+#: (kind, name_or_pattern, is_pattern, line, scope)
+Site = Tuple[str, str, bool, int, str]
+
+
+def collect_sites(sf: SourceFile) -> List[Site]:
+    sites: List[Site] = []
+
+    class V(ScopedVisitor):
+        def visit_Call(self, call: ast.Call) -> None:
+            arg = _name_arg(call)
+            if arg is not None:
+                kind = call.func.attr  # type: ignore[union-attr]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    sites.append((kind, arg.value, False, call.lineno,
+                                  self.scope))
+                elif isinstance(arg, ast.JoinedStr):
+                    sites.append((kind, _pattern_of(arg), True, call.lineno,
+                                  self.scope))
+                # Name/other: dynamic, runtime warn-once covers it
+            self.generic_visit(call)
+
+    V().visit(sf.tree)
+    return sites
+
+
+def _registry():
+    try:
+        from storm_tpu.analysis import metric_names
+        return metric_names
+    except ImportError:  # registry not generated yet: OBS001 is inert
+        return None
+
+
+def check(sf: SourceFile, config: LintConfig) -> List[Finding]:
+    import fnmatch
+
+    findings: List[Finding] = []
+    reg = _registry()
+    if reg is not None and sf.path != "storm_tpu/analysis/metric_names.py":
+        known: Set[str] = set(getattr(reg, "METRIC_NAMES", ()))
+        patterns: Sequence[str] = tuple(getattr(reg, "METRIC_PATTERNS", ()))
+        strict = [p for p in patterns
+                  if len(p.replace("*", "")) >= _STRICT_PATTERN_MIN_LITERAL]
+        for kind, name, is_pattern, line, scope in collect_sites(sf):
+            if is_pattern:
+                ok = name in patterns
+            else:
+                ok = name in known or any(
+                    fnmatch.fnmatchcase(name, p) for p in strict)
+            if not ok:
+                findings.append(Finding(
+                    rule="OBS001", path=sf.path, line=line, scope=scope,
+                    message=(f"metric name {name!r} ({kind}) is not in the "
+                             "generated registry"),
+                    hint=("typo? fix the name; new metric? run `storm-tpu "
+                          "lint --regen-metric-registry` and commit "
+                          "metric_names.py with the change"),
+                    detail=f"{kind}:{name}"))
+    findings.extend(_check_trace_balance(sf))
+    return findings
+
+
+def _check_trace_balance(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        starts: List[ast.Call] = []
+        stops = 0
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not node:
+                continue
+            if isinstance(sub, ast.Call):
+                name = dotted_name(sub.func)
+                tail = name.rsplit(".", 1)[-1]
+                if tail == "start_trace":
+                    starts.append(sub)
+                elif tail == "stop_trace":
+                    stops += 1
+        if starts and stops == 0:
+            findings.append(Finding(
+                rule="OBS002", path=sf.path, line=starts[0].lineno,
+                scope=node.name,
+                message=(f"start_trace in {node.name} has no stop_trace "
+                         "on any path"),
+                hint=("wrap in try/finally (see tracing.device_trace) so "
+                      "the device trace session always closes"),
+                detail="start_trace"))
+    return findings
+
+
+def check_kinds(files: Iterable[SourceFile],
+                config: LintConfig) -> List[Finding]:
+    """OBS003: one literal name used as more than one metric kind."""
+    first: Dict[str, Tuple[str, str, int, str]] = {}  # name -> kind,site
+    findings: List[Finding] = []
+    reported: Set[str] = set()
+    for sf in files:
+        for kind, name, is_pattern, line, scope in collect_sites(sf):
+            if is_pattern:
+                continue
+            if name not in first:
+                first[name] = (kind, sf.path, line, scope)
+                continue
+            kind0, path0, line0, _ = first[name]
+            if kind != kind0 and name not in reported:
+                reported.add(name)
+                findings.append(Finding(
+                    rule="OBS003", path=sf.path, line=line, scope=scope,
+                    message=(f"metric {name!r} used as {kind} here but as "
+                             f"{kind0} at {path0}:{line0}"),
+                    hint=("pick one kind per name; the prometheus family "
+                          "can only have one type"),
+                    detail=f"{name}:{'/'.join(sorted((kind, kind0)))}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Registry generation
+# ---------------------------------------------------------------------------
+
+_HEADER = '''"""Metric-name registry — GENERATED, do not edit by hand.
+
+Regenerate after adding/renaming a metric:
+
+    storm-tpu lint --regen-metric-registry
+
+Generated from every ``counter``/``gauge``/``histogram`` call site in the
+tree. Literal names land in ``METRIC_NAMES``; f-string sites contribute a
+wildcard pattern to ``METRIC_PATTERNS`` (literal chunks joined by ``*``).
+``storm_tpu/analysis/observability.py`` (OBS001) checks call sites against
+this file statically; ``runtime/metrics.py`` warns once at runtime for any
+name that matches neither — together they catch the write-side typo whose
+only other symptom is a flatlined dashboard panel.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+'''
+
+
+def generate_registry(files: Sequence[SourceFile]) -> str:
+    names: Set[str] = set()
+    patterns: Set[str] = set()
+    kinds: Dict[str, Set[str]] = {}
+    for sf in files:
+        if sf.path == "storm_tpu/analysis/metric_names.py":
+            continue
+        for kind, name, is_pattern, _line, _scope in collect_sites(sf):
+            if is_pattern:
+                patterns.add(name)
+            else:
+                names.add(name)
+                kinds.setdefault(name, set()).add(kind)
+    lines = [_HEADER]
+    lines.append("METRIC_NAMES = frozenset({")
+    for n in sorted(names):
+        lines.append(f"    {n!r},")
+    lines.append("})")
+    lines.append("")
+    lines.append("METRIC_PATTERNS = (")
+    for p in sorted(patterns):
+        lines.append(f"    {p!r},")
+    lines.append(")")
+    lines.append("")
+    lines.append("#: literal name -> kinds seen at generation time")
+    lines.append("METRIC_KINDS = {")
+    for n in sorted(kinds):
+        lines.append(f"    {n!r}: {tuple(sorted(kinds[n]))!r},")
+    lines.append("}")
+    lines.append("")
+    lines.append("")
+    lines.append("def is_known(name: str) -> bool:")
+    lines.append("    if name in METRIC_NAMES:")
+    lines.append("        return True")
+    lines.append("    return any(fnmatch.fnmatchcase(name, p)")
+    lines.append("               for p in METRIC_PATTERNS)")
+    lines.append("")
+    return "\n".join(lines)

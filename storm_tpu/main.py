@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import signal
 import sys
 import time
@@ -649,6 +650,79 @@ def _fmt(v):
     return "-" if v is None else v
 
 
+def _lint_cmd(args) -> int:
+    """``storm-tpu lint``: the invariant analyzer (storm_tpu/analysis/)."""
+    from storm_tpu.analysis import (
+        RULES,
+        filter_new,
+        load_baseline,
+        load_config,
+        run_lint,
+        write_baseline,
+    )
+
+    if args.rules:
+        for rule in sorted(RULES):
+            print(f"{rule}  {RULES[rule]}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    paths = args.paths or ["storm_tpu"]
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(ap):
+            print(f"lint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    if args.regen_metric_registry:
+        from storm_tpu.analysis.core import iter_python_files, parse_source
+        from storm_tpu.analysis.observability import generate_registry
+
+        files = []
+        for rel in iter_python_files(["storm_tpu"], root):
+            try:
+                with open(os.path.join(root, rel), encoding="utf-8") as f:
+                    sf = parse_source(f.read(), rel)
+            except OSError:
+                sf = None
+            if sf is not None:
+                files.append(sf)
+        out = os.path.join(root, "storm_tpu", "analysis", "metric_names.py")
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(generate_registry(files))
+        print(f"wrote {os.path.relpath(out, root)}", file=sys.stderr)
+        return 0
+
+    config = load_config(root)
+    findings = run_lint(paths, root, config)
+    baseline_path = os.path.join(root, "storm_tpu", "analysis",
+                                 "baseline.json")
+    baseline = load_baseline(baseline_path)
+
+    if args.update_baseline:
+        write_baseline(baseline_path, findings, prior=baseline)
+        print(f"baseline: {len(findings)} finding(s) -> "
+              f"{os.path.relpath(baseline_path, root)} (fill in the 'why' "
+              "for each new entry)", file=sys.stderr)
+        return 0
+
+    new = findings if args.no_baseline else filter_new(findings, baseline)
+    n_baselined = len(findings) - len(filter_new(findings, baseline))
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in new],
+            "total": len(findings),
+            "baselined": n_baselined,
+            "new": len(new),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        print(f"lint: {len(findings)} finding(s), {n_baselined} baselined, "
+              f"{len(new)} new", file=sys.stderr)
+    return 1 if new else 0
+
+
 def main(argv=None) -> int:
     setup_logging()
     ap = argparse.ArgumentParser(prog="storm_tpu")
@@ -833,7 +907,34 @@ def main(argv=None) -> int:
     bottp.add_argument("--json", action="store_true",
                        help="raw JSON instead of the rendered view")
 
+    lintp = sub.add_parser(
+        "lint",
+        help="run the project's invariant analyzer (lock discipline, "
+             "exactly-once, jit hygiene, observability) over the tree; "
+             "exit 1 on non-baselined findings (docs/OPERATIONS.md "
+             "'Static analysis')")
+    lintp.add_argument("paths", nargs="*", default=[],
+                       help="files/dirs to lint (default: storm_tpu/)")
+    lintp.add_argument("--root", default=".",
+                       help="repo root (pyproject.toml + baseline live here)")
+    lintp.add_argument("--json", action="store_true", dest="as_json",
+                       help="machine-readable findings on stdout")
+    lintp.add_argument("--no-baseline", action="store_true",
+                       help="report every finding, including baselined ones")
+    lintp.add_argument("--update-baseline", action="store_true",
+                       help="accept the current findings into "
+                            "analysis/baseline.json (then edit in the "
+                            "per-finding justifications)")
+    lintp.add_argument("--rules", action="store_true",
+                       help="list rule ids and exit")
+    lintp.add_argument("--regen-metric-registry", action="store_true",
+                       help="regenerate storm_tpu/analysis/metric_names.py "
+                            "from the tree's metric call sites")
+
     args = ap.parse_args(argv)
+
+    if args.cmd == "lint":
+        return _lint_cmd(args)
 
     if args.cmd == "run":
         cfg = _load_config(args)
