@@ -720,54 +720,67 @@ class DistCluster:
 
     def deactivate(self) -> None:
         """Stop spouts pulling; in-flight tuples keep flowing (the first
-        phase of drain(), without the drain wait)."""
+        phase of drain(), without the drain wait).
+
+        Flag flips under the lock; the RPCs run outside it (LCK001, same
+        contract as swap_model) — a recovery that interleaves re-applies
+        spout state from ``self._activated``, which is already False."""
         with self._lock:
             self._activated = False
-            for c in self.clients:
-                c.control("deactivate")
+            clients = list(self.clients)
+        for c in clients:
+            c.control("deactivate")
 
     def activate(self) -> None:
         """Resume spouts after a deactivate/drain (Storm's 'activate')."""
         with self._lock:
             self._activated = True
-            for c in self.clients:
-                c.control("activate")
+            clients = list(self.clients)
+        for c in clients:
+            c.control("activate")
 
     @property
     def activated(self) -> bool:
         return self._activated
 
     def kill(self, wait_secs: float = 0.0) -> None:
+        # State clears under the lock (a recovery after kill must not
+        # resurrect the topology); the kill RPCs run outside it (LCK001) —
+        # with the recipe gone, an interleaved recovery is a no-op.
         with self._lock:
-            self._recipe = None  # a recovery after kill must not resurrect it
+            self._recipe = None
             self._rebalances.clear()
             self._swaps.clear()
-            for c in self.clients:
-                c.control("kill", wait_secs=wait_secs)
+            clients = list(self.clients)
+        for c in clients:
+            c.control("kill", wait_secs=wait_secs)
 
     def shutdown(self) -> None:
         self._closing = True  # recoveries that start after this are no-ops
         self.stop_monitor()
-        with self._lock:  # serialize against any still-running recovery
-            for c in self.clients:
-                try:
-                    c.control("shutdown", timeout=5.0)
-                except Exception:
-                    pass
-                c.close()
-            for p in self.procs:
-                if p is None:
-                    continue
-                try:
-                    p.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    p.kill()
-            for f in self._stderr_files:
-                f.close()
-            self._stderr_files.clear()
+        # Detach everything under the lock (serializes against a recovery
+        # still in flight — it sees empty lists and _closing), then do the
+        # slow teardown outside it: shutdown RPCs plus up-to-10s process
+        # waits under the controller lock stalled every stats/ctl caller
+        # for the whole drain (LCK001).
+        with self._lock:
+            clients, self.clients = list(self.clients), []
+            procs, self.procs = [p for p in self.procs if p is not None], []
+            files, self._stderr_files = list(self._stderr_files), []
             self._stderr_by_index.clear()
-            self.procs.clear()
-            self.clients.clear()
+        for c in clients:
+            try:
+                c.control("shutdown", timeout=5.0)
+            except Exception:
+                pass
+            c.close()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in files:
+            f.close()
 
     def __enter__(self) -> "DistCluster":
         return self
