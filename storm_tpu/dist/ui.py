@@ -51,6 +51,8 @@ class _Hist:
             mean = snap.get("mean")
             total = mean * self.count if mean is not None else float("nan")
         self.sum = total
+        # Worker snapshots don't carry exemplars; the renderer probes this.
+        self.exemplar = None
 
     def snapshot(self) -> Dict[str, Any]:
         return self._snap

@@ -104,21 +104,25 @@ class AdmissionController:
         """``(admitted, reason)``: reason is ``"ok"``, ``"throttled"``
         (tenant over quota), or ``"shed"`` (lane dropped at the edge by
         the current shed level)."""
+        # Registry keys are (component, name); tenant/lane ride the name —
+        # prometheus_text sanitizes non-alnum chars, so these scrape clean.
+        # The outcome prefix is spelled literally inside each counter() call
+        # so the metric-name registry (OBS001) learns `shed_*`/`throttled_*`/
+        # `admitted_*` instead of a vacuous `*_*` that would accept any typo.
+        m = self._metrics
         if self._shed is not None and self.qos.shed_eligible(
                 lane, int(self._shed.value)):
-            self._count("shed", tenant, lane)
+            if m is not None:
+                m.counter(self._component, f"shed_{tenant}").inc()
+                m.counter(self._component, f"shed_lane_{lane}").inc()
             return False, "shed"
         bucket = self._bucket(tenant)
         if bucket is not None and not bucket.try_take(1.0, now):
-            self._count("throttled", tenant, lane)
+            if m is not None:
+                m.counter(self._component, f"throttled_{tenant}").inc()
+                m.counter(self._component, f"throttled_lane_{lane}").inc()
             return False, "throttled"
-        self._count("admitted", tenant, lane)
+        if m is not None:
+            m.counter(self._component, f"admitted_{tenant}").inc()
+            m.counter(self._component, f"admitted_lane_{lane}").inc()
         return True, "ok"
-
-    def _count(self, what: str, tenant: str, lane: str) -> None:
-        if self._metrics is None:
-            return
-        # Registry keys are (component, name); tenant/lane ride the name —
-        # prometheus_text sanitizes non-alnum chars, so these scrape clean.
-        self._metrics.counter(self._component, f"{what}_{tenant}").inc()
-        self._metrics.counter(self._component, f"{what}_lane_{lane}").inc()

@@ -9,11 +9,37 @@ north-star Kafka->Kafka metric (BASELINE.md).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, Optional
 
 import numpy as np
+
+log = logging.getLogger("storm_tpu.metrics")
+
+# Names already flagged as unknown — warn once per process, not per call.
+_unknown_warned: set = set()
+
+
+def _check_name(name: str) -> None:
+    """Warn once for a metric name missing from the generated registry
+    (``storm_tpu/analysis/metric_names.py``). The static side of this
+    check is lint rule OBS001; this runtime side catches names built from
+    variables the AST pass can't see. A typo'd writer name is otherwise
+    invisible: it creates a parallel series while every reader (autoscale,
+    shed, SLO burn, dashboards) watches a flatline."""
+    if name in _unknown_warned:
+        return
+    try:
+        from storm_tpu.analysis.metric_names import is_known
+    except ImportError:  # registry not generated in this checkout
+        return
+    if not is_known(name):
+        _unknown_warned.add(name)
+        log.warning(
+            "metric name %r is not in the generated registry — typo, or "
+            "run `storm-tpu lint --regen-metric-registry` (OBS001)", name)
 
 
 class Counter:
@@ -158,6 +184,7 @@ class MetricsRegistry:
         key = (component, name)
         c = self._counters.get(key)
         if c is None:
+            _check_name(name)  # creation-time only: off the hot path
             with self._lock:
                 c = self._counters.setdefault(key, Counter())
         return c
@@ -166,6 +193,7 @@ class MetricsRegistry:
         key = (component, name)
         g = self._gauges.get(key)
         if g is None:
+            _check_name(name)
             with self._lock:
                 g = self._gauges.setdefault(key, Gauge())
         return g
@@ -174,6 +202,7 @@ class MetricsRegistry:
         key = (component, name)
         h = self._histograms.get(key)
         if h is None:
+            _check_name(name)
             with self._lock:
                 h = self._histograms.setdefault(key, Histogram())
         return h
@@ -268,12 +297,23 @@ def prometheus_text(registries: Dict[str, "MetricsRegistry"]) -> str:
         safe = "".join(c if c.isalnum() else "_" for c in metric)
         return f"storm_tpu_{safe}{suffix}"
 
+    # One `# TYPE` header per family, before its first sample (the
+    # exposition format forbids repeating it per topology label set).
+    typed: set = set()
+
+    def type_line(family: str, kind: str) -> None:
+        if family not in typed:
+            typed.add(family)
+            lines.append(f"# TYPE {family} {kind}")
+
     for topo, reg in sorted(registries.items()):
         for (comp, mname), c in sorted(reg._counters.items()):
             labels = f'{{topology="{_prom_escape(topo)}",component="{_prom_escape(comp)}"}}'
+            type_line(name_of(mname, "_total"), "counter")
             lines.append(f"{name_of(mname, '_total')}{labels} {c.value}")
         for (comp, mname), g in sorted(reg._gauges.items()):
             labels = f'{{topology="{_prom_escape(topo)}",component="{_prom_escape(comp)}"}}'
+            type_line(name_of(mname), "gauge")
             lines.append(f"{name_of(mname)}{labels} {sane(g.value)}")
         for (comp, mname), h in sorted(reg._histograms.items()):
             labels = f'{{topology="{_prom_escape(topo)}",component="{_prom_escape(comp)}"}}'
@@ -285,9 +325,15 @@ def prometheus_text(registries: Dict[str, "MetricsRegistry"]) -> str:
                 tid, ev, ets = h.exemplar
                 ex = (f' # {{trace_id="{_prom_escape(str(tid))}"}}'
                       f" {sane(ev)} {round(ets, 3)}")
+            type_line(name_of(mname, "_count"), "counter")
             lines.append(f"{name_of(mname, '_count')}{labels} {h.count}{ex}")
+            type_line(name_of(mname, "_sum"), "counter")
             lines.append(f"{name_of(mname, '_sum')}{labels} {sane(h.sum)}")
             snap = h.snapshot()
             for q in ("mean", "p50", "p90", "p95", "p99", "max"):
-                lines.append(f"{name_of(mname, '_' + q)}{labels} {sane(snap[q])}")
+                type_line(name_of(mname, "_" + q), "gauge")
+                # .get: facade snapshots from older workers may lack the
+                # newer quantiles (p90/max) — render NaN, don't crash.
+                lines.append(
+                    f"{name_of(mname, '_' + q)}{labels} {sane(snap.get(q))}")
     return "\n".join(lines) + "\n"
