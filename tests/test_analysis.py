@@ -1,0 +1,591 @@
+"""Unit tests for the invariant analyzer (storm_tpu/analysis/).
+
+Each rule gets a positive fixture (a minimal snippet that MUST trip it)
+and a negative fixture (the sanctioned idiom that must NOT) — the negative
+fixtures are the idioms the real tree relies on (condition-wait under its
+own lock, finally-based deferral, static_argnames branching), so a checker
+regression shows up here before it floods the clean-tree gate."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from storm_tpu.analysis import (
+    LintConfig,
+    filter_new,
+    lint_source,
+    load_baseline,
+    load_config,
+    write_baseline,
+)
+from storm_tpu.analysis.core import parse_source
+from storm_tpu.analysis.locks import check_ordering
+from storm_tpu.analysis.observability import check_kinds, generate_registry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(src, **cfg):
+    return lint_source(textwrap.dedent(src), "fixture.py",
+                       LintConfig(**cfg) if cfg else None)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# LCK001: blocking call under a lock
+# ---------------------------------------------------------------------------
+
+
+def test_lck001_sleep_under_with_lock():
+    fs = lint("""
+        import threading, time
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+    """)
+    assert rules_of(fs) == {"LCK001"}
+    (f,) = fs
+    assert f.detail == "time.sleep"
+    assert "hint" in f.to_dict() and f.line == 8
+
+
+def test_lck001_sleep_outside_lock_ok():
+    fs = lint("""
+        import threading, time
+        class C:
+            def f(self):
+                with self._lock:
+                    x = 1
+                time.sleep(1)
+    """)
+    assert fs == []
+
+
+def test_lck001_acquire_release_region():
+    fs = lint("""
+        import time
+        def f(lock):
+            lock.acquire()
+            time.sleep(1)
+            lock.release()
+            time.sleep(2)
+    """)
+    assert [f.rule for f in fs] == ["LCK001"]
+    assert fs[0].line == 5  # only the sleep inside the region
+
+
+def test_lck001_condition_wait_on_held_lock_exempt():
+    # Condition.wait releases the lock — the sanctioned sleep-under-lock
+    # (continuous batcher's dispatcher loop).
+    fs = lint("""
+        class C:
+            def f(self):
+                with self._cond:
+                    while not self._ready:
+                        self._cond.wait(timeout=0.1)
+    """)
+    assert fs == []
+
+
+def test_lck001_foreign_wait_under_lock_flagged():
+    fs = lint("""
+        class C:
+            def f(self):
+                with self._lock:
+                    self._event.wait()
+    """)
+    assert rules_of(fs) == {"LCK001"}
+
+
+def test_lck001_queue_get_vs_dict_get():
+    fs = lint("""
+        class C:
+            def f(self):
+                with self._lock:
+                    item = self.queue.get()
+                    val = self._cache.get("key")
+    """)
+    assert len(fs) == 1 and fs[0].detail == "self.queue.get"
+
+
+def test_lck001_future_result_and_zero_arg_join():
+    fs = lint("""
+        class C:
+            def f(self):
+                with self._lock:
+                    v = fut.result()
+                    self._thread.join()
+                    s = ",".join(parts)
+    """)
+    assert sorted(f.detail for f in fs) == ["fut.result", "self._thread.join"]
+
+
+def test_lck001_configured_blocking_method():
+    src = """
+        class C:
+            def f(self):
+                with self._lock:
+                    self.client.control("drain")
+    """
+    assert lint(src) == []  # not blocking by default
+    fs = lint(src, blocking_methods=["control"])
+    assert rules_of(fs) == {"LCK001"}
+
+
+# ---------------------------------------------------------------------------
+# LCK002: lock-order inversion
+# ---------------------------------------------------------------------------
+
+
+def _files(*srcs):
+    return [parse_source(textwrap.dedent(s), f"mod{i}.py")
+            for i, s in enumerate(srcs)]
+
+
+def test_lck002_inversion_flagged():
+    fs = check_ordering(_files("""
+        class A:
+            def f(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+            def g(self):
+                with self._lock_b:
+                    with self._lock_a:
+                        pass
+    """), LintConfig())
+    assert [f.rule for f in fs] == ["LCK002"]
+    assert "opposite order" in fs[0].message
+
+
+def test_lck002_consistent_order_ok():
+    fs = check_ordering(_files("""
+        class A:
+            def f(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+            def g(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+    """), LintConfig())
+    assert fs == []
+
+
+def test_lck002_cross_file_inversion():
+    fs = check_ordering(_files(
+        """
+        import m
+        def f():
+            with GLOBAL_LOCK:
+                with m.OTHER_LOCK:
+                    pass
+        """,
+        """
+        import m
+        def g():
+            with m.OTHER_LOCK:
+                with GLOBAL_LOCK:
+                    pass
+        """), LintConfig())
+    # different modules -> different global-lock identities; only the
+    # m.OTHER_LOCK pair unifies, and the GLOBAL_LOCK halves are
+    # per-module — no shared 2-cycle unless identities match
+    assert all(f.rule == "LCK002" for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# XO001: exactly-once discipline
+# ---------------------------------------------------------------------------
+
+
+def test_xo001_unhandled_else_path():
+    fs = lint("""
+        class FooBolt:
+            def execute(self, t):
+                if t.values[0] > 0:
+                    self.collector.ack(t)
+    """)
+    assert rules_of(fs) == {"XO001"}
+
+
+def test_xo001_all_paths_acked_ok():
+    fs = lint("""
+        class FooBolt:
+            def execute(self, t):
+                if t.values[0] > 0:
+                    self.collector.ack(t)
+                else:
+                    self.collector.fail(t)
+    """)
+    assert fs == []
+
+
+def test_xo001_finally_deferral_rescues_all_paths():
+    fs = lint("""
+        class BarBolt:
+            def execute(self, t):
+                try:
+                    risky(t.values)
+                    if maybe():
+                        return
+                finally:
+                    self._pending.append(t)
+    """)
+    assert fs == []
+
+
+def test_xo001_exception_edge_swallowed_unhandled():
+    # the except arm swallows the error without failing the tuple: the
+    # ledger waits forever — the exact silent-drop class
+    fs = lint("""
+        class QuxBolt:
+            def execute(self, t):
+                try:
+                    self.collector.ack(t)
+                except Exception:
+                    pass
+    """)
+    assert rules_of(fs) == {"XO001"}
+
+
+def test_xo001_raise_through_is_handled():
+    # BoltExecutor._run catches and fails the tuple
+    fs = lint("""
+        class BazBolt:
+            def execute(self, t):
+                if not valid(t.values):
+                    raise ValueError("bad")
+                self.collector.ack(t)
+    """)
+    assert fs == []
+
+
+def test_xo001_test_position_call_not_ownership():
+    fs = lint("""
+        class TickBolt:
+            def execute(self, t):
+                if is_tick(t):
+                    return
+                self.collector.ack(t)
+    """)
+    # `if is_tick(t)` reads the tuple; the True arm returns it unhandled
+    assert rules_of(fs) == {"XO001"}
+
+
+def test_xo001_deferral_and_store_count():
+    fs = lint("""
+        class DeferBolt:
+            def execute(self, t):
+                if fast(t.values):
+                    self.registry.defer(t)
+                else:
+                    self._by_key[t.values[0]] = t
+    """)
+    assert fs == []
+
+
+def test_xo001_non_tuple_classes_skipped():
+    fs = lint("""
+        class Helper:
+            def execute(self, t):
+                return 1
+    """)
+    assert fs == []
+
+
+def test_xo001_abstract_body_skipped():
+    fs = lint("""
+        class BaseBolt:
+            def execute(self, t):
+                raise NotImplementedError
+        class PassBolt:
+            def execute(self, t):
+                ...
+    """)
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# JIT001-004: tracer hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_jit001_numpy_on_traced_arg():
+    fs = lint("""
+        import jax
+        import numpy as np
+        @jax.jit
+        def f(x):
+            return np.sum(x)
+    """)
+    assert rules_of(fs) == {"JIT001"}
+
+
+def test_jit001_jnp_ok():
+    fs = lint("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            return jnp.sum(x)
+    """)
+    assert fs == []
+
+
+def test_jit002_branch_on_tracer():
+    fs = lint("""
+        import jax
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+    assert rules_of(fs) == {"JIT002"}
+
+
+def test_jit002_static_argname_branch_ok():
+    fs = lint("""
+        import functools, jax
+        @functools.partial(jax.jit, static_argnames=("flag",))
+        def f(x, flag):
+            if flag:
+                return x
+            return -x
+    """)
+    assert fs == []
+
+
+def test_jit002_shape_branch_ok():
+    # x.shape is concrete at trace time — the kernels' row-block math
+    fs = lint("""
+        import jax
+        @jax.jit
+        def f(x):
+            rows = x.shape[0]
+            r8 = rows if rows > 8 else 8
+            assert x.ndim == 2
+            return x * r8
+    """)
+    assert fs == []
+
+
+def test_jit003_clock_read():
+    fs = lint("""
+        import jax, time
+        @jax.jit
+        def f(x):
+            t0 = time.time()
+            return x * t0
+    """)
+    assert rules_of(fs) == {"JIT003"}
+
+
+def test_jit004_host_sync():
+    fs = lint("""
+        import jax
+        @jax.jit
+        def f(x):
+            y = x * 2
+            y.block_until_ready()
+            return float(y)
+    """)
+    assert rules_of(fs) == {"JIT004"} and len(fs) == 2
+
+
+def test_jit_call_form_target_resolved():
+    # the engine builds fwd as a closure, then self._fwd = jax.jit(fwd)
+    fs = lint("""
+        import jax
+        import numpy as np
+        def build():
+            def fwd(params, batch):
+                return np.dot(params, batch)
+            return jax.jit(fwd)
+    """)
+    assert rules_of(fs) == {"JIT001"}
+
+
+def test_unjitted_function_ignored():
+    fs = lint("""
+        import numpy as np, time
+        def f(x):
+            if x > 0:
+                time.sleep(0)
+            return np.sum(x)
+    """)
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# OBS001-003: observability hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_obs001_unknown_metric_name():
+    fs = lint("""
+        def f(m):
+            m.counter("bolt", "bogus_metric_typo").inc()
+    """)
+    assert rules_of(fs) == {"OBS001"}
+    assert "registry" in fs[0].message
+
+
+def test_obs001_registered_name_ok():
+    fs = lint("""
+        def f(m):
+            m.counter("bolt", "emitted").inc()
+            m.histogram("bolt", "execute_ms").observe(1.0)
+    """)
+    assert fs == []
+
+
+def test_obs001_fstring_pattern_matches_registry():
+    # tracing's span() records f"{name}_ms" -> pattern "*_ms"
+    fs = lint("""
+        def f(m, name):
+            m.histogram("bolt", f"{name}_ms").observe(1.0)
+    """)
+    assert fs == []
+
+
+def test_obs002_unbalanced_trace():
+    fs = lint("""
+        import jax
+        def f(d):
+            jax.profiler.start_trace(d)
+            work()
+    """)
+    assert rules_of(fs) == {"OBS002"}
+
+
+def test_obs002_balanced_trace_ok():
+    fs = lint("""
+        import jax
+        def f(d):
+            jax.profiler.start_trace(d)
+            try:
+                work()
+            finally:
+                jax.profiler.stop_trace()
+    """)
+    assert fs == []
+
+
+def test_obs003_conflicting_kinds():
+    fs = check_kinds(_files(
+        'def f(m):\n    m.counter("a", "dual_series").inc()\n',
+        'def g(m):\n    m.histogram("b", "dual_series").observe(1)\n',
+    ), LintConfig())
+    assert [f.rule for f in fs] == ["OBS003"]
+
+
+def test_registry_generation_roundtrip():
+    src = generate_registry(_files(
+        'def f(m):\n'
+        '    m.counter("a", "gen_fixture_total").inc()\n'
+        '    m.histogram("a", f"lane_{k}_ms").observe(1)\n'))
+    ns = {}
+    exec(compile(src, "metric_names.py", "exec"), ns)
+    assert "gen_fixture_total" in ns["METRIC_NAMES"]
+    assert "lane_*_ms" in ns["METRIC_PATTERNS"]
+    assert ns["is_known"]("lane_7_ms") and not ns["is_known"]("nope")
+
+
+# ---------------------------------------------------------------------------
+# baseline, config, CLI
+# ---------------------------------------------------------------------------
+
+_POSITIVE = """
+    import threading, time
+    class C:
+        def f(self):
+            with self._lock:
+                time.sleep(1)
+"""
+
+
+def test_baseline_suppression_roundtrip(tmp_path):
+    fs = lint(_POSITIVE)
+    assert fs
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, fs)
+    baseline = load_baseline(path)
+    assert filter_new(fs, baseline) == []
+    # an unrelated edit moving the line must NOT invalidate the entry
+    moved = lint("\n\n# comment\n" + textwrap.dedent(_POSITIVE))
+    assert moved[0].line != fs[0].line
+    assert filter_new(moved, baseline) == []
+    # preserving prior justifications across rewrites
+    data = json.loads(open(path).read())
+    data["findings"][0]["why"] = "reviewed: intentional"
+    open(path, "w").write(json.dumps(data))
+    write_baseline(path, fs, prior=load_baseline(path))
+    assert "intentional" in open(path).read()
+
+
+def test_config_from_pyproject(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.storm-tpu.lint]
+        disable = ["LCK002"]
+        exclude = ["generated/*"]
+        blocking_methods = ["rpc_call"]
+        exclude_XO001 = ["storm_tpu/legacy/*"]
+    """))
+    cfg = load_config(str(tmp_path))
+    assert "LCK002" not in cfg.enable and "LCK001" in cfg.enable
+    assert cfg.blocking_methods == ["rpc_call"]
+    assert cfg.excluded("LCK001", "generated/x.py")
+    assert cfg.excluded("XO001", "storm_tpu/legacy/old.py")
+    assert not cfg.excluded("LCK001", "storm_tpu/legacy/old.py")
+
+
+def test_repo_config_has_grpc_blocking_methods():
+    cfg = load_config(ROOT)
+    assert "control" in cfg.blocking_methods
+
+
+def test_cli_json_schema(capsys):
+    from storm_tpu.main import main
+    rc = main(["lint", "--root", ROOT, "--json",
+               "storm_tpu/analysis/core.py"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(out) == {"findings", "total", "baselined", "new"}
+    for f in out["findings"]:
+        assert {"rule", "description", "path", "line", "scope", "message",
+                "hint", "key"} <= set(f)
+
+
+def test_cli_rules_listing(capsys):
+    from storm_tpu.main import main
+    assert main(["lint", "--rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("LCK001", "LCK002", "XO001", "JIT001", "OBS001"):
+        assert rule in out
+
+
+def test_cli_bad_path(capsys):
+    from storm_tpu.main import main
+    assert main(["lint", "--root", ROOT, "no/such/dir"]) == 2
+
+
+def test_cli_nonzero_on_new_finding(tmp_path, capsys):
+    from storm_tpu.main import main
+    pkg = tmp_path / "storm_tpu" / "analysis"
+    pkg.mkdir(parents=True)
+    bad = tmp_path / "mod.py"
+    bad.write_text(textwrap.dedent(_POSITIVE))
+    assert main(["lint", "--root", str(tmp_path), "mod.py"]) == 1
+    err = capsys.readouterr()
+    assert "LCK001" in err.out

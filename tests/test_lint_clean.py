@@ -1,0 +1,64 @@
+"""Tier-1 gate: `storm-tpu lint` must run clean on the real tree.
+
+"Clean" means zero NON-BASELINED findings — the baseline
+(storm_tpu/analysis/baseline.json) holds the reviewed-and-accepted holds
+(engine dispatch-order device_put, controller recovery transactions, the
+Kafka per-partition send serialization), each with a justification. A new
+finding here means new code violated a checked invariant OR a checker
+regressed; either way it fails tier-1 until fixed or reviewed into the
+baseline. docs/OPERATIONS.md "Static analysis" is the runbook.
+"""
+
+import json
+import os
+
+from storm_tpu.analysis import filter_new, load_baseline, load_config, run_lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(ROOT, "storm_tpu", "analysis", "baseline.json")
+
+
+def test_tree_has_no_new_findings():
+    config = load_config(ROOT)
+    findings = run_lint(["storm_tpu"], ROOT, config)
+    new = filter_new(findings, load_baseline(BASELINE))
+    assert new == [], "new lint findings (fix or baseline with a why):\n" + \
+        "\n".join(f.render() for f in new)
+
+
+def test_baseline_entries_are_justified():
+    # every accepted finding carries a real reviewed justification, not
+    # the --update-baseline placeholder
+    data = json.load(open(BASELINE))
+    for row in data["findings"]:
+        why = row.get("why", "")
+        assert why and "accepted via --update-baseline" not in why, \
+            f"baseline entry needs a justification: {row['key']}"
+
+
+def test_baseline_has_no_stale_entries():
+    # entries whose finding no longer exists should be pruned — a stale
+    # key silently suppresses a future regression at the same site
+    config = load_config(ROOT)
+    live = {f.key() for f in run_lint(["storm_tpu"], ROOT, config)}
+    stale = [k for k in load_baseline(BASELINE) if k not in live]
+    assert stale == [], f"baseline entries with no live finding: {stale}"
+
+
+def test_metric_registry_is_fresh():
+    # the committed metric_names.py must match what --regen-metric-registry
+    # would produce from today's call sites
+    from storm_tpu.analysis.core import iter_python_files, parse_source
+    from storm_tpu.analysis.observability import generate_registry
+
+    files = []
+    for rel in iter_python_files(["storm_tpu"], ROOT):
+        with open(os.path.join(ROOT, rel), encoding="utf-8") as f:
+            sf = parse_source(f.read(), rel)
+        if sf is not None:
+            files.append(sf)
+    committed = open(os.path.join(
+        ROOT, "storm_tpu", "analysis", "metric_names.py")).read()
+    assert generate_registry(files) == committed, \
+        "metric registry is stale: run `storm-tpu lint " \
+        "--regen-metric-registry` and commit the result"
