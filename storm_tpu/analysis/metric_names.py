@@ -47,6 +47,8 @@ METRIC_NAMES = frozenset({
     'inbox_depth',
     'ingest_lag_ms',
     'instances_inferred',
+    'plan_active',
+    'plan_corrections',
     'produce_ms',
     'profile_regressions',
     'shed_decisions',
@@ -110,6 +112,8 @@ METRIC_KINDS = {
     'inbox_depth': ('gauge',),
     'ingest_lag_ms': ('histogram',),
     'instances_inferred': ('counter',),
+    'plan_active': ('gauge',),
+    'plan_corrections': ('counter',),
     'produce_ms': ('histogram',),
     'profile_regressions': ('counter',),
     'shed_decisions': ('counter',),

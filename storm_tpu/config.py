@@ -556,6 +556,75 @@ class ObsConfig:
 
 
 @dataclass
+class PlanConfig:
+    """SLO-aware joint planner (storm_tpu/plan/): offline solve + online
+    correct.
+
+    The offline half (``storm-tpu plan``, ``bench.py --plan``) needs no
+    config at all — it solves over a ProfileStore snapshot for an explicit
+    (rate, SLO) target. This section configures the *online* half: when
+    ``enabled``, the daemon attaches a :class:`storm_tpu.plan.corrector.
+    PlanCorrector` to the Observatory loop; it consumes the bottleneck
+    verdict + SLO-burn tracker and moves only the named limiter's knob,
+    and the Autoscaler defers its own global scale-up to it.
+    """
+
+    enabled: bool = False
+    # Offline solve at daemon startup when both targets are set and a
+    # profile baseline is available (obs.baseline_path or live curves):
+    # the plan is logged and served on the /plan route; it is NOT applied
+    # automatically — apply is an operator decision (docs/OPERATIONS.md).
+    rate_rows_s: float = 0.0
+    slo_p99_ms: float = 0.0
+    # Solver feasibility margin: candidates must keep predicted device
+    # utilization at or below this fraction.
+    headroom: float = 0.8
+    # Compile-cost amortization horizon for shapes not yet warm.
+    horizon_s: float = 600.0
+    # Framework overhead floor added to every predicted e2e p99 (host
+    # scheduling, serialization, transport — everything outside the
+    # profiled device stages and the modeled batching waits).
+    overhead_ms: float = 15.0
+    # Charged for a cold shape when the profile has no compile sample yet.
+    default_compile_ms: float = 500.0
+    # A (engine, bucket) curve with fewer device-stage samples than this
+    # counts as "cold" in coverage and is excluded from the solve.
+    min_samples: int = 8
+    # ---- online corrector ----------------------------------------------------
+    correct: bool = True
+    # Consecutive hot Observatory steps (burn tripped AND a named leader)
+    # before the corrector moves a knob.
+    hot_steps: int = 2
+    # Consecutive calm steps before one correction step is reverted.
+    calm_steps: int = 6
+    # Post-move cooldown steps during which the corrector holds still
+    # (hysteresis: one bounded step, then watch).
+    hold_steps: int = 3
+    # Hard parallelism bound for corrector moves; 0 = per-kind defaults
+    # (ACCEL_MAX_PARALLELISM for inference bolts, CPU_MAX_PARALLELISM
+    # otherwise — see runtime/autoscale.py).
+    max_parallelism: int = 0
+
+    def __post_init__(self) -> None:
+        if not 0.0 < float(self.headroom) <= 1.0:
+            raise ValueError(
+                f"plan.headroom must be in (0, 1], got {self.headroom!r}")
+        if self.rate_rows_s < 0 or self.slo_p99_ms < 0:
+            raise ValueError("plan targets must be >= 0")
+        if self.horizon_s <= 0:
+            raise ValueError("plan.horizon_s must be > 0")
+        if self.overhead_ms < 0 or self.default_compile_ms < 0:
+            raise ValueError("plan cost floors must be >= 0")
+        if min(self.hot_steps, self.calm_steps) < 1 or self.hold_steps < 0:
+            raise ValueError(
+                "need plan.hot_steps/calm_steps >= 1 and hold_steps >= 0")
+        if self.min_samples < 1:
+            raise ValueError("plan.min_samples must be >= 1")
+        if self.max_parallelism < 0:
+            raise ValueError("plan.max_parallelism must be >= 0 (0 = auto)")
+
+
+@dataclass
 class QosConfig:
     """Admission control & QoS: per-tenant token-bucket rate limiting at the
     spout edge, weighted priority lanes with earliest-deadline-first batch
@@ -708,6 +777,10 @@ class Config:
     # Continuous profiling & SLO-burn observatory (storm_tpu/obs/): cost
     # curves the planner consumes + burn-rate shed signal. TOML: [obs].
     obs: ObsConfig = field(default_factory=ObsConfig)
+    # SLO-aware joint planner (storm_tpu/plan/): offline cost-model solve
+    # over the profile curves + online bottleneck-named corrector in the
+    # Observatory loop. TOML: [plan].
+    plan: PlanConfig = field(default_factory=PlanConfig)
     # Confidence-gated model cascade (storm_tpu/cascade/): tiered serving
     # where easy records accept at a cheap tier and only the hard residue
     # escalates to the flagship. TOML: [cascade].

@@ -186,6 +186,43 @@ class DistRuntimeView:
                 "workers": out["workers"],
                 "bottleneck": None}
 
+    async def plan(self, query: dict) -> Dict[str, Any]:
+        """Dist flavor of the /plan action. Engines (and their profile
+        curves) live in the workers, not the controller, so the
+        controller solves over a committed baseline when the operator
+        points ``obs.baseline_path`` at one — but it always contributes
+        what only it has: per-component utilization MERGED across
+        workers, the planner's framework-headroom input."""
+        util = await asyncio.to_thread(self._dist.utilization, "ui")
+        out: Dict[str, Any] = {"topology": self.name,
+                               "workers": util["workers"],
+                               "utilization": util["components"]}
+        try:
+            rate = float(query.get("rate", 0) or 0)
+            slo = float(query.get("slo_ms", 0) or 0)
+        except ValueError:
+            return {**out, "error": "rate/slo_ms must be numbers"}
+        from storm_tpu.obs.profile import profile_store
+
+        snap = await asyncio.to_thread(profile_store().snapshot)
+        base = profile_store().baseline
+        if not snap.get("engines") and base is not None:
+            snap = base  # controller-side curves come from the baseline
+        if rate <= 0 or slo <= 0:
+            from storm_tpu.plan.model import CostModel
+
+            out["coverage"] = CostModel(snap).coverage()
+            out["note"] = ("no target given: pass ?rate=<rows/s>"
+                           "&slo_ms=<ms> to solve")
+            return out
+        from storm_tpu.plan import Target, solve
+
+        res = await asyncio.to_thread(
+            solve, snap, Target(rate, slo), engine=query.get("engine"),
+            utilization=util["components"])
+        out.update(res.to_dict())
+        return out
+
     async def worker_logs(self, index: int, tail_bytes: int = 16384) -> str:
         return await asyncio.to_thread(self._dist.worker_logs, index, tail_bytes)
 

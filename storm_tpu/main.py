@@ -258,6 +258,15 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
             sink_components=tuple(sink_id for _, sink_id in pairs)).start()
         for shedder in shedders:
             shedder.burn = observatory.burn
+        if cfg.plan.enabled:
+            from storm_tpu.plan import PlanCorrector
+
+            # Online half of the planner: stepped by the Observatory
+            # loop, consumes this topology's verdict + burn state, and
+            # (below) makes the autoscalers defer their global scale-up.
+            observatory.corrector = PlanCorrector(
+                rt, cfg.plan, attributor=observatory.bottleneck,
+                burn=observatory.burn)
     scalers = []
     if autoscale_target_ms > 0:
         from storm_tpu.runtime.autoscale import (
@@ -289,6 +298,7 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
             # hot even before the latency policy trips.
             for scaler in scalers:
                 scaler.bottleneck = observatory.bottleneck
+                scaler.corrector = observatory.corrector
     ui = None
     if ui_port >= 0:
         from storm_tpu.runtime.ui import UIServer
@@ -301,6 +311,7 @@ async def _run_daemon(name: str, cfg: Config, duration: float,
           f"(model={desc}, broker={cfg.broker.kind}"
           f"{', qos' if shedders else ''}"
           f"{', obs' if observatory else ''}"
+          f"{', plan' if observatory and observatory.corrector else ''}"
           f"{', autoscaling' if scalers else ''}"
           f"{f', ui http://127.0.0.1:{ui.port}' if ui else ''})",
           file=sys.stderr)
@@ -646,6 +657,115 @@ def _bottleneck_cmd(args) -> int:
     return 0
 
 
+def _render_solve(out: dict) -> int:
+    """Human view of one solver result (shared by the online and offline
+    ``storm-tpu plan`` paths)."""
+    cov = out.get("coverage") or {}
+    if not out.get("feasible"):
+        if "feasible" in out:
+            print("INFEASIBLE:", out.get("why") or "no reason reported")
+            if out.get("binding_stage"):
+                print(f"binding stage: {out['binding_stage']}")
+            best = out.get("best_infeasible") or {}
+            if best.get("capacity_rows_s") is not None:
+                print(f"closest candidate: {best.get('candidate')} -> "
+                      f"capacity {best['capacity_rows_s']} rows/s, "
+                      f"p99 {best.get('p99_ms')} ms")
+        else:
+            print(out.get("note", "no target given"))
+        for eng, row in cov.items():
+            cells = ", ".join(
+                f"{b}:{c['status']}({c['samples']})"
+                for b, c in row.get("buckets", {}).items()) or "(none)"
+            print(f"coverage {eng}: {cells}")
+        return 1 if "feasible" in out else 0
+    plan = out["plan"]
+    pred = plan.get("prediction", {})
+    print(f"PLAN engine={plan['engine']} bucket={plan['bucket']} "
+          f"deadline={plan['deadline_ms']}ms "
+          f"parallelism={plan['parallelism']} "
+          f"continuous={plan['continuous']} "
+          f"pipeline_depth={plan['pipeline_depth']} "
+          f"max_inflight={plan['max_inflight']} "
+          f"(replica cost {plan['replica_cost']})")
+    print(f"predicted: p99={pred.get('p99_ms')}ms "
+          f"capacity={pred.get('capacity_rows_s')} rows/s "
+          f"util={pred.get('util')} "
+          f"cold={pred.get('cold')}")
+    for stage, ms in (pred.get("stages") or {}).items():
+        print(f"  {stage:<16} {ms:>9}ms")
+    if pred.get("queue_ms") is not None:
+        print(f"  {'queue_ms':<16} {pred['queue_ms']:>9}ms")
+    print("apply with: storm-tpu run ... " +
+          " ".join(f"--set {a}" for a in plan.get("override_args", [])))
+    for risk in out.get("framework_risks") or []:
+        print(f"risk: {risk['note']}")
+    corr = out.get("corrector")
+    if corr is not None:
+        print(f"corrector: enabled={corr.get('enabled')} "
+              f"corrections={corr.get('corrections')}")
+    return 0
+
+
+def _plan_cmd(args) -> int:
+    """``storm-tpu plan``: solve for the cheapest config meeting a
+    (rate, p99 SLO) target. Online against a running topology's UI
+    endpoint (live curves + corrector state), or offline from a
+    committed ``PROFILE_*.json`` via ``--baseline`` — no daemon needed."""
+    if args.baseline:
+        from storm_tpu.plan import Target, solve
+
+        with open(args.baseline) as fh:
+            snap = json.load(fh)
+        if not (args.rate > 0 and args.slo_ms > 0):
+            print("offline solve needs --rate and --slo-ms", file=sys.stderr)
+            return 2
+        res = solve(snap, Target(args.rate, args.slo_ms,
+                                 headroom=args.headroom),
+                    engine=args.engine)
+        out = res.to_dict()
+        if args.json:
+            print(json.dumps(out, indent=2, default=str))
+            return 0 if res.feasible else 1
+        return _render_solve(out)
+
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from storm_tpu.config import env_control_token
+
+    base = args.url.rstrip("/")
+    topo = urllib.parse.quote(args.topology, safe="")
+    q = {}
+    if args.rate > 0:
+        q["rate"] = args.rate
+    if args.slo_ms > 0:
+        q["slo_ms"] = args.slo_ms
+    if args.engine:
+        q["engine"] = args.engine
+    q["headroom"] = args.headroom
+    qs = urllib.parse.urlencode(q)
+    req = urllib.request.Request(
+        f"{base}/api/v1/topology/{topo}/plan?{qs}")
+    token = args.token or env_control_token()
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        print(e.read().decode("utf-8", "replace"), file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    return _render_solve(out)
+
+
 def _fmt(v):
     return "-" if v is None else v
 
@@ -907,6 +1027,35 @@ def main(argv=None) -> int:
     bottp.add_argument("--json", action="store_true",
                        help="raw JSON instead of the rendered view")
 
+    planp = sub.add_parser(
+        "plan",
+        help="solve for the cheapest config meeting a (rate, p99 SLO) "
+             "target over the profile curves: online against a running "
+             "topology's /plan route, or offline from a committed "
+             "PROFILE_*.json via --baseline (no daemon needed); prints "
+             "the plan as ready-to-paste --set overrides")
+    planp.add_argument("topology", nargs="?", default="inference-topology")
+    planp.add_argument("--rate", type=float, default=0.0,
+                       help="target offered rate, rows/s")
+    planp.add_argument("--slo-ms", type=float, default=0.0, dest="slo_ms",
+                       help="target end-to-end p99 SLO, ms")
+    planp.add_argument("--engine", default=None,
+                       help="engine/model key to plan for (default: the "
+                            "cheapest profiled engine)")
+    planp.add_argument("--headroom", type=float, default=0.8,
+                       help="max predicted device utilization a feasible "
+                            "plan may run at")
+    planp.add_argument("--baseline", default=None,
+                       help="solve offline over this PROFILE_*.json "
+                            "instead of a running topology")
+    planp.add_argument("--url", default="http://127.0.0.1:8080",
+                       help="base URL of the daemon's --ui-port server")
+    planp.add_argument("--token", default=None,
+                       help="bearer token (default: "
+                            "$STORM_TPU_CONTROL_TOKEN)")
+    planp.add_argument("--json", action="store_true",
+                       help="raw JSON instead of the rendered view")
+
     lintp = sub.add_parser(
         "lint",
         help="run the project's invariant analyzer (lock discipline, "
@@ -964,6 +1113,9 @@ def main(argv=None) -> int:
 
     if args.cmd == "bottleneck":
         return _bottleneck_cmd(args)
+
+    if args.cmd == "plan":
+        return _plan_cmd(args)
 
     if args.cmd == "dist-run":
         cfg = _load_config(args)

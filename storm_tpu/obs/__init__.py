@@ -102,6 +102,11 @@ class Observatory:
         self.bottleneck = BottleneckAttributor(
             runtime, self.cfg, self.capacity, self.lag, clock=clock)
         self.last_regressions: List[dict] = []
+        # Online plan corrector (storm_tpu/plan/corrector.py): attach one
+        # (``obs.corrector = PlanCorrector(...)``) and the loop steps it
+        # after the attributor each interval — it reads this step's
+        # verdict + burn state. None = planning off (the default).
+        self.corrector = None
         self._m_regress = runtime.metrics.counter("obs", "profile_regressions")
         self._last_sentinel = clock()
         self._task: Optional[asyncio.Task] = None
@@ -141,6 +146,11 @@ class Observatory:
                 self.step()
             except Exception as e:  # pragma: no cover
                 log.warning("obs step failed: %s", e)
+            if self.corrector is not None:
+                try:
+                    await self.corrector.step()
+                except Exception as e:  # pragma: no cover
+                    log.warning("plan corrector step failed: %s", e)
 
     # ---- the control step ----------------------------------------------------
 
@@ -221,6 +231,8 @@ class Observatory:
             "baseline_loaded": self.profile.baseline is not None,
             "utilization": self.capacity.last,
             "bottleneck": self.last_verdict(),
+            "corrector": (self.corrector.snapshot()
+                          if self.corrector is not None else None),
         }
 
     def last_verdict(self) -> dict:

@@ -144,11 +144,17 @@ class ProfileStore:
                             "compiles": compiles.get(key, {})}
         return {"engines": engines}
 
-    def cost_of(self, key: str) -> Optional[dict]:
+    def cost_of(self, key: str,
+                min_samples: int = 1) -> Optional[dict]:
         """Live per-row cost summary for one engine (the cascade
         inventory's measured-cost column): cheapest observed bucket view
         — mean device ms/row at the largest profiled bucket (marginal
-        cost is what tier ordering cares about)."""
+        cost is what tier ordering cares about).
+
+        Returns ``None`` when the curve can't answer; callers that need
+        to know *why* (cold curve vs never-seen key) use
+        :meth:`coverage`, which reports a per-(engine, bucket) status
+        instead of collapsing both cases into ``None``."""
         with self._lock:
             per = self._buckets.get(key)
             if not per:
@@ -156,11 +162,38 @@ class ProfileStore:
             padded = max(per)
             b = per[padded]
         s = b.stages["device_ms"].snapshot()
-        if not s["count"]:
+        if s["count"] < max(1, int(min_samples)):
             return None
         return {"bucket": padded, "batches": b.batches,
                 "device_ms_mean": round(s["mean"], 4),
                 "ms_per_row": round(s["mean"] / padded, 5)}
+
+    def coverage(self, min_samples: int = 1) -> dict:
+        """Which curves exist and which are trustworthy — the planner's
+        answer to ``cost_of`` returning a bare ``None``.
+
+        Per engine, per padded bucket: ``samples`` (device-stage
+        observations) and ``status`` — ``"ok"`` at or above
+        ``min_samples``, ``"cold"`` below it. A key absent from the
+        returned mapping entirely is *unknown* (never profiled), the
+        third state ``None`` used to hide. ``compile_known`` lists the
+        shapes with a recorded XLA compile cost."""
+        with self._lock:
+            buckets = {k: dict(v) for k, v in self._buckets.items()}
+            compiles = {k: sorted(v) for k, v in self._compiles.items()}
+        need = max(1, int(min_samples))
+        out: Dict[str, dict] = {}
+        for key in sorted(set(buckets) | set(compiles)):
+            rows = {}
+            for padded in sorted(buckets.get(key, ())):
+                n = buckets[key][padded].stages["device_ms"].snapshot()["count"]
+                rows[str(padded)] = {
+                    "samples": n,
+                    "status": "ok" if n >= need else "cold"}
+            out[key] = {"buckets": rows,
+                        "compile_known": [str(p) for p in
+                                          compiles.get(key, [])]}
+        return out
 
     # ---- baseline / regression sentinel --------------------------------------
 

@@ -87,6 +87,14 @@ class Autoscaler:
         # bottleneck means a component pegged at capacity scales before its
         # queue backs up far enough to move p50/inbox_frac.
         self.bottleneck = None
+        # Planner deferral (storm_tpu.plan.corrector): with an enabled
+        # PlanCorrector attached (``scaler.corrector = obs.corrector``),
+        # scale-UP is the corrector's job — it moves the NAMED limiter
+        # instead of this policy's fixed component — so step() only
+        # records a ``defer_plan`` decision when hot. Scale-down (cost
+        # reclamation) stays here; the corrector only walks back its own
+        # corrections.
+        self.corrector = None
         self._deferred = 0
         self._task: Optional[asyncio.Task] = None
         self._calm = 0
@@ -162,6 +170,16 @@ class Autoscaler:
             self._deferred = 0
 
         if self._hot >= 2 and current < p.max_parallelism:
+            if (self.corrector is not None
+                    and getattr(self.corrector, "enabled", False)):
+                # Planning enabled: the corrector owns targeted scale-up.
+                log.info(
+                    "scale-up of %s deferred to the plan corrector",
+                    p.component)
+                self._flight("defer_plan", current, current, p50,
+                             inbox_frac, capacity, cap_hot)
+                self._hot = 0
+                return None
             if (self.shedder is not None and self.shedder.level == 0
                     and self._deferred < 1):
                 # Shed-first/scale-second: give the (faster) shed loop one

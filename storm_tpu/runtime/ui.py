@@ -25,6 +25,9 @@ Read routes
     GET /api/v1/topology/{name}/cascade       per-tier engines + escalation
     GET /api/v1/topology/{name}/bottleneck    per-component utilization +
                                               ranked bottleneck verdict
+    GET /api/v1/topology/{name}/plan          SLO-aware planner: solve for
+                                              ?rate=&slo_ms= (+ coverage,
+                                              online corrector state)
     GET /metrics                              Prometheus text exposition
 
 Admin routes (POST, like Storm UI's topology actions)
@@ -479,6 +482,48 @@ class UIServer:
                                           "(obs.enabled=false?)"}
                 out = {"topology": rt.name}
                 out.update(await asyncio.to_thread(obs.bottleneck_snapshot))
+                return 200, out
+            if action == "plan" and method == "GET":
+                # SLO-aware planner (storm_tpu/plan): with ?rate=<rows/s>
+                # &slo_ms=<ms> (optional &engine=, &headroom=) solve over
+                # the live ProfileStore for the cheapest config meeting
+                # the target; without a target, report curve coverage and
+                # the online corrector's state. Dist views answer through
+                # the controller (merged utilization as the planner's
+                # framework input).
+                if hasattr(rt, "plan"):  # DistRuntimeView
+                    return 200, await rt.plan(query)
+                obs = getattr(rt, "obs", None)
+                corr = getattr(obs, "corrector", None)
+                out: Dict[str, Any] = {
+                    "topology": rt.name,
+                    "corrector": (corr.snapshot() if corr is not None
+                                  else None)}
+                from storm_tpu.obs.profile import profile_store
+
+                snap = await asyncio.to_thread(profile_store().snapshot)
+                try:
+                    rate = float(query.get("rate", 0) or 0)
+                    slo = float(query.get("slo_ms", 0) or 0)
+                    headroom = float(query.get("headroom", 0.8))
+                except ValueError:
+                    return 400, {"error": "rate/slo_ms/headroom must be "
+                                          "numbers"}
+                if rate <= 0 or slo <= 0:
+                    from storm_tpu.plan.model import CostModel
+
+                    out["coverage"] = CostModel(snap).coverage()
+                    out["note"] = ("no target given: pass ?rate=<rows/s>"
+                                   "&slo_ms=<ms> to solve")
+                    return 200, out
+                from storm_tpu.plan import Target, solve
+
+                target = Target(rate, slo, headroom=headroom)
+                util = obs.capacity.last if obs is not None else None
+                res = await asyncio.to_thread(
+                    solve, snap, target, engine=query.get("engine"),
+                    utilization=util)
+                out.update(res.to_dict())
                 return 200, out
             if method != "POST":
                 return 405, {"error": "topology actions are POST"}

@@ -158,3 +158,33 @@ def test_bottleneck_artifact_gates():
     assert dist["merged"]["inference-bolt"]["busy_s"] > 0.0
     assert art["capture_session"].startswith("cap-")
     assert art["code_version"]
+
+
+def test_plan_artifact_gates():
+    """BENCH_PLAN_r13.json backs the round-13 planner docs: the solved
+    config meets a (rate, p99 SLO) target the stock default misses, at
+    strictly lower replica cost than worst-case provisioning, with a
+    per-stage predicted-vs-measured table and a reported mean
+    prediction error from the same interleaved session."""
+    import json
+
+    art = json.loads((REPO / "BENCH_PLAN_r13.json").read_text())
+    assert art["metric"] == "plan_slo_ab_lenet5"
+    gates = art["gates"]
+    assert gates["planned_meets_slo"] is True
+    assert gates["default_misses_slo"] is True
+    assert gates["planned_cheaper_than_worstcase"] is True
+    cost = art["replica_cost"]
+    assert cost["planned"] < cost["worstcase"]
+    assert art["repeats"] >= 3
+    for arm in ("default", "planned", "worstcase"):
+        assert len(art["arms"][arm]["p99_ms_samples"]) == art["repeats"]
+    pv = art["prediction_vs_measured"]
+    assert pv["stages"], "per-stage predicted-vs-measured table missing"
+    for row in pv["stages"].values():
+        assert "predicted_ms" in row and "measured_ms" in row
+    assert pv["mean_abs_error_pct"] is not None
+    assert pv["predicted_p99_ms"] > 0 and pv["measured_p99_ms"] > 0
+    assert art["plan"]["parallelism"] >= 1
+    assert art["capture_session"].startswith("cap-")
+    assert art["code_version"]

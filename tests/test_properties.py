@@ -5,8 +5,13 @@ and the micro-batcher — invariants that example-based tests undersample."""
 import json
 
 import numpy as np
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property tests need hypothesis; skip (not error) without it")
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from storm_tpu.runtime.acker import AckLedger
 from storm_tpu.runtime.tuples import new_id
