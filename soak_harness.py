@@ -85,6 +85,11 @@ def main() -> int:
     ap.add_argument("--out", default="SOAK_r05.json")
     ap.add_argument("--slo-ms", type=float, default=1000.0,
                     help="per-window sink p50 target for the SLO timeline")
+    ap.add_argument("--chaos", action="store_true",
+                    help="add a dist-grade chaos phase: engine-hang "
+                         "injections under a live watchdog "
+                         "(batch.watchdog_ms) driving a quarantine + "
+                         "engine replacement mid-soak")
     args = ap.parse_args()
 
     plat = os.environ.get("STORM_TPU_PLATFORM")
@@ -144,7 +149,13 @@ def main() -> int:
     model_cfg = ModelConfig(name="lenet5", checkpoint=ckpt,
                             input_shape=(32, 32, 1), num_classes=10)
     batch_cfg = BatchConfig(max_batch=64, max_wait_ms=20.0, buckets=(8, 64),
-                            max_inflight=2)
+                            max_inflight=2,
+                            # chaos phase: a 2.5s injected hang against a
+                            # 500ms fetch deadline trips the watchdog; two
+                            # consecutive trips quarantine the engine and
+                            # the operator swaps in a fresh one mid-soak.
+                            watchdog_ms=500.0 if args.chaos else 0.0,
+                            watchdog_trips=2)
     run_cfg = Config()
     run_cfg.topology.message_timeout_s = 120.0
 
@@ -203,6 +214,7 @@ def main() -> int:
 
     cluster = LocalCluster()
     t0 = time.perf_counter()
+    wd_stats = None
     try:
         cluster.submit_topology("soak", run_cfg, tb.build())
         log("topology up; starting feed")
@@ -236,6 +248,17 @@ def main() -> int:
             (0.93, "chaos_kill_infer_2",
              lambda: chaos.crash_bolt("infer", 1)),
         ]
+        if args.chaos:
+            from storm_tpu.resilience import get_injector
+
+            def arm_engine_hang():
+                inj = get_injector()
+                inj.bind_flight(rt.flight)
+                # Two consecutive hung batches = watchdog_trips, so this
+                # single injection drives the full quarantine->replace arc.
+                inj.configure(engine_hang_ms=2500.0, engine_hang_next=2)
+
+            plan.insert(4, (0.48, "chaos_engine_hang", arm_engine_hang))
         next_plan = 0
         window_s = 10.0
         next_window = time.perf_counter() + window_s
@@ -286,6 +309,18 @@ def main() -> int:
             time.sleep(0.5)
         drained = stub.topic_size(OUT) >= 2 * n
         log(f"drained={drained} out={stub.topic_size(OUT)}/{2 * n}")
+        if args.chaos:
+            infer_m = cluster.metrics("soak").get("infer", {})
+            wd_stats = {k: infer_m.get(k)
+                        for k in ("watchdog_trips", "engine_quarantined")}
+            # The quarantine->replace arc as flight events: the drained
+            # audit above already proves the REPLACEMENT engine served
+            # (the injection lands mid-soak), these make it explicit.
+            wd_stats["flight"] = [
+                {k: v for k, v in ev.items() if k != "ts"}
+                for ev in rt.flight.tail(400)
+                if ev.get("kind") in ("engine_quarantined",
+                                      "engine_replaced")]
     finally:
         try:
             cluster.shutdown()
@@ -372,6 +407,7 @@ def main() -> int:
         },
         "events": events,
         "timeline": timeline,
+        "chaos": None,
         "note": "echo lane = sha256 of each record, committed in the SAME "
                 "transaction (same tuple tree) as its prediction and its "
                 "offset; identity-level exactly-once on the echo lane + "
@@ -379,6 +415,16 @@ def main() -> int:
                 "prediction lane (the product wire contract carries no "
                 "correlation id, reference parity)",
     }
+    if args.chaos:
+        from storm_tpu.resilience import get_injector
+
+        snap = get_injector().snapshot()
+        artifact["chaos"] = {
+            "enabled": True,
+            "injections": sum(snap["counts"].values()),
+            "counts": snap["counts"],
+            "watchdog": wd_stats,
+        }
     out = json.dumps(artifact, indent=1)
     if args.out == "-":
         print(out)

@@ -34,9 +34,19 @@ METRIC_NAMES = frozenset({
     'delivered',
     'device_ms',
     'dispatch_wait_ms',
+    'dist_circuit_opens',
+    'dist_heartbeat_miss',
+    'dist_parked_batches',
+    'dist_replay_throttle_ms',
+    'dist_replay_throttled',
+    'dist_rerouted',
+    'dist_send_failures',
+    'dist_send_retries',
+    'dist_wire_errors',
     'dropped_stale',
     'e2e_latency_ms',
     'emitted',
+    'engine_quarantined',
     'errors',
     'escalation_rate',
     'execute_ms',
@@ -62,6 +72,7 @@ METRIC_NAMES = frozenset({
     'txn_aborts',
     'txn_commits',
     'txn_offsets_deferred',
+    'watchdog_trips',
 })
 
 METRIC_PATTERNS = (
@@ -71,6 +82,7 @@ METRIC_PATTERNS = (
     'cascade_accepted_tier*',
     'cascade_decided_lane_*',
     'cascade_escalated_lane_*',
+    'dist_circuit_open_w*',
     'e2e_latency_ms_*',
     'fair_rows_*_*',
     'fair_starved_*_*',
@@ -99,9 +111,19 @@ METRIC_KINDS = {
     'delivered': ('counter',),
     'device_ms': ('histogram',),
     'dispatch_wait_ms': ('histogram',),
+    'dist_circuit_opens': ('counter',),
+    'dist_heartbeat_miss': ('counter',),
+    'dist_parked_batches': ('counter',),
+    'dist_replay_throttle_ms': ('histogram',),
+    'dist_replay_throttled': ('counter',),
+    'dist_rerouted': ('counter',),
+    'dist_send_failures': ('counter',),
+    'dist_send_retries': ('counter',),
+    'dist_wire_errors': ('counter',),
     'dropped_stale': ('counter',),
     'e2e_latency_ms': ('histogram',),
     'emitted': ('counter',),
+    'engine_quarantined': ('gauge',),
     'errors': ('counter',),
     'escalation_rate': ('gauge',),
     'execute_ms': ('histogram',),
@@ -127,6 +149,7 @@ METRIC_KINDS = {
     'txn_aborts': ('counter',),
     'txn_commits': ('counter',),
     'txn_offsets_deferred': ('counter',),
+    'watchdog_trips': ('counter',),
 }
 
 

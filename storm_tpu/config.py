@@ -71,8 +71,26 @@ class BatchConfig:
     # round-robin: a tenant:lane key passed over for this many batch
     # formations is served first in the next one.
     starvation_rounds: int = 4
+    # Per-batch deadline on the fetch side of the dispatch/fetch ring:
+    # a batch whose device result is not ready within this many ms after
+    # launch fails with EngineWatchdogTimeout — failing ONLY its own
+    # sources (the exception-isolation contract) and releasing its ring
+    # slot + staging buffer, instead of wedging the fetch thread forever.
+    # 0 disables the watchdog (plain block_until_ready).
+    watchdog_ms: float = 0.0
+    # Consecutive watchdog trips that quarantine the engine: it is
+    # dropped from the shared-engine cache (so the next build is a fresh
+    # replacement) and refuses new dispatches. 0 = never quarantine.
+    watchdog_trips: int = 3
 
     def __post_init__(self) -> None:
+        if float(self.watchdog_ms) < 0:
+            raise ValueError(
+                f"batch.watchdog_ms must be >= 0, got {self.watchdog_ms!r}")
+        if int(self.watchdog_trips) < 0:
+            raise ValueError(
+                "batch.watchdog_trips must be >= 0, got "
+                f"{self.watchdog_trips!r}")
         if int(self.starvation_rounds) < 1:
             raise ValueError(
                 "batch.starvation_rounds must be >= 1, got "
@@ -724,6 +742,93 @@ class QosConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Transport retry / circuit-breaker / replay-pacing knobs (round 14).
+
+    TOML: ``[resilience]``. These parameterize
+    :mod:`storm_tpu.resilience`: the deadline-budgeted retry policy
+    wrapping WorkerClient RPCs, the per-peer circuit breaker in the
+    PeerSender path, and the token bucket that paces post-recovery
+    replay drains.
+    """
+
+    # Retry policy (exponential backoff + full jitter).
+    retry_attempts: int = 4
+    retry_base_ms: float = 50.0
+    retry_cap_ms: float = 2000.0
+    # Total wall-clock budget across all attempts of one logical send.
+    retry_deadline_s: float = 30.0
+    # Circuit breaker: consecutive failures that open a peer's circuit,
+    # and how long it stays open before the half-open probe.
+    circuit_failures: int = 5
+    circuit_reset_s: float = 3.0
+    # Replay-storm suppression: tuples/s a sender pushes at a freshly
+    # recovered peer during the pacing window. 0 = auto (derived from
+    # max_spout_pending over the window, i.e. the ledger's own bound).
+    replay_rate: float = 0.0
+    replay_window_s: float = 10.0
+
+    def __post_init__(self) -> None:
+        if int(self.retry_attempts) < 1:
+            raise ValueError("resilience.retry_attempts must be >= 1, got "
+                             f"{self.retry_attempts!r}")
+        for name in ("retry_base_ms", "retry_cap_ms", "retry_deadline_s",
+                     "circuit_reset_s", "replay_rate", "replay_window_s"):
+            if float(getattr(self, name)) < 0:
+                raise ValueError(
+                    f"resilience.{name} must be >= 0, got "
+                    f"{getattr(self, name)!r}")
+        if int(self.circuit_failures) < 1:
+            raise ValueError("resilience.circuit_failures must be >= 1, "
+                             f"got {self.circuit_failures!r}")
+
+
+@dataclass
+class ChaosConfig:
+    """Dist-grade fault injection (round 14). TOML: ``[chaos]``.
+
+    Rides ``cfg.to_dict()`` through the submit recipe, so arming it on
+    the controller arms every worker's process-wide injector
+    (:mod:`storm_tpu.resilience.chaos`). All injections are logged as
+    ``chaos_injection`` flight events. NEVER enable in production; the
+    daemon/soak/bench drive it to measure recovery, not to serve.
+    """
+
+    enabled: bool = False
+    seed: int = 0
+    # Added latency per outbound Deliver/Ack RPC (+ uniform jitter).
+    wire_latency_ms: float = 0.0
+    wire_jitter_ms: float = 0.0
+    # Fraction of outbound send attempts dropped (raised as ChaosDrop,
+    # which the retry/circuit stack treats as a real outage).
+    wire_drop_pct: float = 0.0
+    # Fraction of outbound frames bit-flipped — exercises the CRC check
+    # in dist/wire.py and the WireError -> replay path behind it.
+    corrupt_pct: float = 0.0
+    # Engine-hang injection: hold each injected batch's result this long
+    # (arm per-batch via the worker 'chaos' control RPC knob
+    # engine_hang_next; the config only sets the hold duration).
+    engine_hang_ms: float = 0.0
+    # Daemon-driven worker chaos: SIGKILL a random worker every this many
+    # seconds under ``dist`` runs (0 = off). Recovery comes from the
+    # heartbeat monitor; the kill itself is logged by the controller.
+    kill_worker_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        for name in ("wire_drop_pct", "corrupt_pct"):
+            v = float(getattr(self, name))
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(
+                    f"chaos.{name} must be in [0, 1], got {v!r}")
+        for name in ("wire_latency_ms", "wire_jitter_ms", "engine_hang_ms",
+                     "kill_worker_s"):
+            if float(getattr(self, name)) < 0:
+                raise ValueError(
+                    f"chaos.{name} must be >= 0, got "
+                    f"{getattr(self, name)!r}")
+
+
+@dataclass
 class PipelineConfig:
     """One model pipeline (spout -> inference -> sink) inside a multi-model
     topology: several of these share one process and one TPU slice
@@ -785,6 +890,11 @@ class Config:
     # where easy records accept at a cheap tier and only the hard residue
     # escalates to the flagship. TOML: [cascade].
     cascade: CascadeConfig = field(default_factory=CascadeConfig)
+    # Mesh resilience (storm_tpu/resilience/): transport retry policy,
+    # per-peer circuit breakers, replay pacing. TOML: [resilience].
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    # Dist-grade fault injection for drills/benches. TOML: [chaos].
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     # Multi-model topology: non-empty => ``run`` builds one spout->infer->sink
     # chain per entry instead of the single-model DAG. TOML: [[pipelines]].
     pipelines: list = field(default_factory=list)

@@ -126,6 +126,17 @@ class DistCluster:
         self._swaps: Dict[str, dict] = {}
         self._activated = True
         self._closing = False
+        # Controller-side observability: heartbeat misses and recoveries
+        # happen HERE, not on any worker, so they need their own registry
+        # and flight recorder. Named ctrl_metrics because .metrics() is
+        # already the worker-aggregation method.
+        from storm_tpu.runtime.metrics import MetricsRegistry
+        from storm_tpu.runtime.tracing import FlightRecorder
+
+        self.ctrl_metrics = MetricsRegistry()
+        self.flight = FlightRecorder()
+        self._hb_miss = self.ctrl_metrics.counter(
+            "controller", "dist_heartbeat_miss")
         if addrs:
             for addr in addrs:
                 self.clients.append(WorkerClient(addr, token=self._token))
@@ -580,18 +591,32 @@ class DistCluster:
                     try:
                         client.control("ping", timeout=max(1.0, interval_s))
                         fails[i] = 0
-                    except Exception:
+                    except Exception as e:
                         fails[i] += 1
+                        self._hb_miss.inc()
+                        self.flight.event(
+                            "dist_heartbeat_miss", worker=i,
+                            consecutive=fails[i], error=str(e),
+                            throttle_s=0.5)
                     if fails[i] < misses:
                         continue
                     log.error("worker %d missed %d heartbeats; recovering",
                               i, fails[i])
-                    fails[i] = 0
                     try:
                         (on_dead or self.recover_worker)(i)
                     except Exception:
+                        # Leave fails[i] at the threshold: the next missed
+                        # ping re-triggers recovery IMMEDIATELY. Resetting
+                        # before recovery succeeded (the old behaviour)
+                        # granted a failed recovery a second full `misses`
+                        # grace window on top of the first — doubling
+                        # detection latency exactly when the worker is
+                        # provably down.
                         log.exception("recovery of worker %d failed "
                                       "(will retry on next detection)", i)
+                    else:
+                        fails[i] = 0
+                        self.flight.event("dist_worker_recovered", worker=i)
 
         self._monitor = threading.Thread(
             target=loop, name="dist-heartbeat", daemon=True

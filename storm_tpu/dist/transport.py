@@ -38,6 +38,8 @@ import grpc
 
 from storm_tpu.dist import wire
 from storm_tpu.dist.wire import WIRE_VERSION
+from storm_tpu.resilience.retry import (RETRYABLE_BROAD, RETRYABLE_NARROW,
+                                        RetryPolicy, _rpc_code, is_fatal_rpc)
 from storm_tpu.runtime.tracing import TraceContext
 from storm_tpu.runtime.tuples import Tuple
 
@@ -178,9 +180,19 @@ def decode_acks(payload: bytes) -> List[Tup[str, int, int]]:
 class WorkerClient:
     """Channel to one worker's Dist service. ``token=None`` reads
     STORM_TPU_CONTROL_TOKEN (the controller's export); a non-empty token
-    rides every RPC as metadata."""
+    rides every RPC as metadata.
 
-    def __init__(self, target: str, token: Optional[str] = None) -> None:
+    RPCs ride a deadline-budgeted retry policy
+    (:class:`storm_tpu.resilience.RetryPolicy`): Control and Ack retry
+    the broad transient-code set, Deliver retries UNAVAILABLE only (a
+    timed-out Deliver may already be enqueued — re-sending it would
+    double-deliver, so it is left to ledger-timeout replay). Fatal codes
+    (UNAUTHENTICATED, INVALID_ARGUMENT, ...) never retry. ``retry=None``
+    builds the default policy; pass an ``attempts=1`` policy to restore
+    one-shot semantics."""
+
+    def __init__(self, target: str, token: Optional[str] = None,
+                 retry: Optional["RetryPolicy"] = None) -> None:
         self.target = target
         if token is None:
             token = _env_token()
@@ -189,6 +201,7 @@ class WorkerClient:
         self._deliver = self._channel.unary_unary(f"/{SERVICE}/Deliver")
         self._ack = self._channel.unary_unary(f"/{SERVICE}/Ack")
         self._control = self._channel.unary_unary(f"/{SERVICE}/Control")
+        self.retry = RetryPolicy() if retry is None else retry
 
     def deliver(self, payload: bytes, timeout: float = 60.0,
                 traceparent: Optional[str] = None) -> None:
@@ -198,26 +211,51 @@ class WorkerClient:
         md = self._md or ()
         if traceparent:
             md = md + (("traceparent", traceparent),)
-        self._deliver(payload, timeout=timeout, metadata=md or None)
+        self.retry.call_sync(
+            lambda t: self._deliver(payload, timeout=t, metadata=md or None),
+            op_timeout=timeout, codes=RETRYABLE_NARROW)
 
     def ack(self, payload: bytes, timeout: float = 60.0) -> None:
-        self._ack(payload, timeout=timeout, metadata=self._md)
+        self.retry.call_sync(
+            lambda t: self._ack(payload, timeout=t, metadata=self._md),
+            op_timeout=timeout, codes=RETRYABLE_BROAD)
 
     def control(self, cmd: str, timeout: float = 120.0, **kwargs: Any) -> Dict:
         req = json.dumps({"cmd": cmd, **kwargs}).encode("utf-8")
-        resp = json.loads(self._control(req, timeout=timeout,
-                                        metadata=self._md))
+        resp = json.loads(self.retry.call_sync(
+            lambda t: self._control(req, timeout=t, metadata=self._md),
+            op_timeout=timeout, codes=RETRYABLE_BROAD))
         if resp.get("error"):
             raise RuntimeError(f"{self.target} {cmd}: {resp['error']}")
         return resp
 
     def wait_ready(self, timeout: float = 30.0) -> None:
+        """Poll ping until the worker answers — but classify failures: a
+        worker that is UP and rejecting us (bad control token ->
+        UNAUTHENTICATED, protocol mismatch -> INVALID_ARGUMENT) will
+        never become ready, so waiting out the full timeout just hides
+        the real error for 30 s. Fail fast on those; keep polling only
+        on connectivity-shaped failures."""
         deadline = time.monotonic() + timeout
         while True:
             try:
-                self.control("ping", timeout=2.0)
+                # codes=frozenset(): this loop IS the retry policy;
+                # stacking the client's backoff under it would stretch
+                # the poll period.
+                resp = json.loads(self.retry.call_sync(
+                    lambda t: self._control(
+                        json.dumps({"cmd": "ping"}).encode("utf-8"),
+                        timeout=t, metadata=self._md),
+                    op_timeout=2.0, codes=frozenset()))
+                if resp.get("error"):  # answered but unhealthy: keep polling
+                    raise RuntimeError(resp["error"])
                 return
-            except Exception:
+            except Exception as e:
+                if is_fatal_rpc(e):
+                    raise RuntimeError(
+                        f"worker {self.target} rejected the handshake "
+                        f"({_rpc_code(e)}): check the control token / "
+                        "version skew") from e
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"worker {self.target} never became ready")
                 time.sleep(0.1)

@@ -31,9 +31,13 @@ from typing import Any, Dict, List, Optional, Tuple as Tup
 
 import grpc
 
-from storm_tpu.config import Config
+from storm_tpu.config import Config, ResilienceConfig
 from storm_tpu.dist import transport, wire
 from storm_tpu.dist.transport import DistHandler, WorkerClient
+from storm_tpu.resilience import (ChaosDrop, CircuitBreaker, RetryPolicy,
+                                  TokenBucket, get_injector, install_chaos)
+from storm_tpu.resilience.retry import (RETRYABLE_BROAD, RETRYABLE_NARROW,
+                                        is_retryable)
 from storm_tpu.runtime.acker import AckLedger
 from storm_tpu.runtime.cluster import TargetGroup, TopologyRuntime
 from storm_tpu.runtime.executor import BoltExecutor, SpoutExecutor, clone_component
@@ -50,15 +54,43 @@ class PeerSender:
     thread so gRPC never blocks the event loop. Backpressure is end-to-end,
     not local: the queue is unbounded (see __init__), volume is bounded by
     ``max_spout_pending`` on the root spouts, and the receiving side's
-    `Deliver` RPC blocks until its executor inboxes accept the batch."""
+    `Deliver` RPC blocks until its executor inboxes accept the batch.
+
+    Failure handling (round 14): each send rides the resilience retry
+    policy (full-jitter backoff; Deliver retries UNAVAILABLE only — the
+    pre-first-byte guarantee — Ack retries the broad set). Consecutive
+    exhausted sends open this peer's :class:`CircuitBreaker`; while open
+    the loop PARKS the batch (re-routing reroutable tuples to surviving
+    replicas via the runtime hook) instead of dropping it, leaning on
+    ``max_spout_pending`` for bounding. When the circuit closes again —
+    the peer recovered — the first ``replay_window_s`` of tuples drain
+    through a token bucket so the replay burst cannot re-flatten it."""
 
     #: soft byte cap per Deliver RPC, well under the 64MB gRPC message limit
     MAX_BATCH_BYTES = 8 * 1024 * 1024
     MAX_BATCH_ITEMS = 512
-    RETRIES = 3
 
-    def __init__(self, addr: str, wire_format: str = "binary") -> None:
-        self.client = WorkerClient(addr)
+    def __init__(self, addr: str, wire_format: str = "binary",
+                 resilience: Optional[ResilienceConfig] = None) -> None:
+        res = resilience if resilience is not None else ResilienceConfig()
+        self.resilience = res
+        self._retry = RetryPolicy(
+            attempts=int(res.retry_attempts),
+            base_s=res.retry_base_ms / 1e3,
+            cap_s=res.retry_cap_ms / 1e3,
+            deadline_s=res.retry_deadline_s,
+        )
+        # attempts=1 on the client: THIS sender owns the retry loop (its
+        # backoff must sleep on the event loop, not a gRPC worker thread);
+        # stacking the client's sync retries under it would square the
+        # attempt count.
+        self.client = WorkerClient(addr, retry=RetryPolicy(attempts=1))
+        self.circuit = CircuitBreaker(
+            failures=int(res.circuit_failures),
+            reset_s=res.circuit_reset_s,
+            on_open=self._circuit_opened,
+            on_close=self._circuit_closed,
+        )
         # Unbounded on purpose: acks must never lose to backpressure (a
         # dropped ack = timeout + replay), and tuple volume is already
         # bounded end-to-end by max_spout_pending on the root spouts plus
@@ -71,6 +103,88 @@ class PeerSender:
         # on first flush and cached. None = not yet negotiated.
         self._wire_format = wire_format
         self._use_binary: Optional[bool] = None
+        # Recovery pacing state (armed by begin_recovery_pacing).
+        self._pacer: Optional[TokenBucket] = None
+        self._pace_until = 0.0
+        self._pace_rate_fn = None  # () -> tuples/s, set by the runtime
+        # Re-route hook: async (component, task, tuple) -> bool, set by
+        # the runtime; None = parking only.
+        self._reroute = None
+        # Observability hooks (None outside a runtime, e.g. unit tests).
+        self._flight = None
+        self._m: Dict[str, Any] = {}
+
+    # ---- wiring (runtime) ------------------------------------------------
+
+    def bind_obs(self, metrics, flight, peer_idx: int) -> None:
+        """Register this sender's counters under the ``_transport``
+        pseudo-component of the hosting runtime's registry."""
+        self._flight = flight
+        self._peer_idx = peer_idx
+        self._m = {
+            "retries": metrics.counter("_transport", "dist_send_retries"),
+            "failures": metrics.counter("_transport", "dist_send_failures"),
+            "opens": metrics.counter("_transport", "dist_circuit_opens"),
+            "state": metrics.gauge("_transport",
+                                   f"dist_circuit_open_w{peer_idx}"),
+            "parked": metrics.counter("_transport", "dist_parked_batches"),
+            "rerouted": metrics.counter("_transport", "dist_rerouted"),
+            "throttled": metrics.counter("_transport",
+                                         "dist_replay_throttled"),
+            "throttle_ms": metrics.histogram("_transport",
+                                             "dist_replay_throttle_ms"),
+        }
+        # A replacement sender re-binds the same per-peer gauge: reset it,
+        # or the dead predecessor's open-circuit 1 latches forever.
+        self._m["state"].set(0)
+
+    def set_reroute(self, fn) -> None:
+        self._reroute = fn
+
+    def begin_recovery_pacing(self, rate: float, window_s: float) -> None:
+        """Route the next ``window_s`` of tuple sends through a token
+        bucket at ``rate`` tuples/s (burst = 1 s worth)."""
+        if rate <= 0 or window_s <= 0:
+            return
+        self._pacer = TokenBucket(rate, burst=rate)
+        self._pace_until = time.monotonic() + window_s
+        log.info("peer %s: pacing replays at %.1f tuples/s for %.1fs",
+                 self.client.target, rate, window_s)
+
+    # ---- circuit callbacks (worker loop / gRPC threads) ------------------
+
+    def _circuit_opened(self) -> None:
+        if "state" in self._m:
+            self._m["state"].set(1)
+            self._m["opens"].inc()
+        if self._flight is not None:
+            self._flight.event("dist_circuit_open", peer=self.client.target,
+                               opens=self.circuit.opens)
+        log.warning("peer %s circuit OPEN (consecutive send failures); "
+                    "parking/re-routing until the half-open probe",
+                    self.client.target)
+
+    def _circuit_closed(self) -> None:
+        if "state" in self._m:
+            self._m["state"].set(0)
+        if self._flight is not None:
+            self._flight.event("dist_circuit_close", peer=self.client.target)
+        # The peer just came back: everything queued behind the open
+        # circuit (plus the ledger's replays) is about to drain — pace it.
+        rate_fn = self._pace_rate_fn
+        rate = 0.0
+        if rate_fn is not None:
+            try:
+                rate = float(rate_fn())
+            except Exception:
+                rate = 0.0
+        self.begin_recovery_pacing(rate, self.resilience.replay_window_s)
+        log.info("peer %s circuit closed (probe succeeded)",
+                 self.client.target)
+
+    def _note_retry(self, attempt: int, exc: BaseException) -> None:
+        if "retries" in self._m:
+            self._m["retries"].inc()
 
     def start(self) -> None:
         self._task = asyncio.get_event_loop().create_task(self._loop())
@@ -110,13 +224,40 @@ class PeerSender:
                       (x for x in items if x[0] == "t")]
             acks = [(op, r, e) for kind, op, r, e in
                     (x for x in items if x[0] == "a")]
+            await self._flush(tuples, acks)
+
+    async def _flush(self, tuples, acks) -> None:
+        """Send one batch, parking (never silently dropping) while this
+        peer's circuit is open. Only non-transient failures — encode bugs,
+        auth rejects — abandon the batch to ledger-timeout replay."""
+        while tuples or acks:
+            if not self.circuit.allow():
+                if tuples and self._reroute is not None:
+                    kept = []
+                    for c, i, t in tuples:
+                        if await self._reroute(c, i, t):
+                            if "rerouted" in self._m:
+                                self._m["rerouted"].inc()
+                        else:
+                            kept.append((c, i, t))
+                    tuples = kept
+                    if not tuples and not acks:
+                        return
+                if "parked" in self._m:
+                    self._m["parked"].inc()
+                await asyncio.sleep(
+                    min(max(self.circuit.wait_s(), 0.05), 0.5))
+                continue
             try:
                 binary = await self._negotiate()
                 if acks:
                     enc_acks = (wire.encode_acks if binary
                                 else transport.encode_acks)
-                    await self._send(self.client.ack, enc_acks(acks))
+                    await self._send(self.client.ack, enc_acks(acks),
+                                     codes=RETRYABLE_BROAD)
+                    acks = []
                 if tuples:
+                    await self._pace(len(tuples))
                     # First sampled tuple's context doubles as the RPC-level
                     # traceparent header (per-tuple contexts travel in the
                     # frame/envelope itself; the header is for gRPC-aware
@@ -128,12 +269,42 @@ class PeerSender:
                     await self._send(
                         functools.partial(self.client.deliver, traceparent=tp),
                         enc_tuples(tuples),
+                        codes=RETRYABLE_NARROW,
                     )
+                    tuples = []
+                self.circuit.record_success()
+                return
+            except asyncio.CancelledError:
+                raise
             except Exception as e:
-                # Exhausted retries: the affected trees hit the ledger
-                # timeout and replay from the spout (at-least-once, same as
-                # a lost Netty transfer in Storm).
+                self.circuit.record_failure()
+                if "failures" in self._m:
+                    self._m["failures"].inc()
+                if not is_retryable(e):
+                    # Encode bug / auth reject / protocol error: retrying
+                    # the same bytes cannot succeed. The affected trees
+                    # hit the ledger timeout and replay from the spout
+                    # (at-least-once, same as a lost Netty transfer in
+                    # Storm).
+                    log.warning("peer %s send failed (not retryable, "
+                                "leaving to replay): %s",
+                                self.client.target, e)
+                    return
                 log.warning("peer %s send failed: %s", self.client.target, e)
+                await asyncio.sleep(self._retry.backoff(0))
+
+    async def _pace(self, n: int) -> None:
+        """Recovery-window pacing: wait out the token bucket before
+        pushing ``n`` tuples at a freshly recovered peer."""
+        pacer = self._pacer
+        if pacer is None or time.monotonic() >= self._pace_until:
+            return
+        wait = pacer.take(n)
+        if wait > 0:
+            if "throttled" in self._m:
+                self._m["throttled"].inc()
+                self._m["throttle_ms"].observe(wait * 1e3)
+            await asyncio.sleep(wait)
 
     async def _negotiate(self) -> bool:
         """Decide (once) whether this peer takes binary frames.
@@ -162,15 +333,26 @@ class PeerSender:
                      "falling back to the JSON envelope", self.client.target)
         return self._use_binary
 
-    async def _send(self, fn, payload: bytes) -> None:
-        for attempt in range(self.RETRIES):
-            try:
-                await asyncio.to_thread(fn, payload)
-                return
-            except Exception:
-                if attempt == self.RETRIES - 1:
-                    raise
-                await asyncio.sleep(0.1 * 2**attempt)
+    async def _send(self, fn, payload: bytes, *, codes) -> None:
+        """One RPC under the resilience retry policy. Chaos injection
+        (latency, drops, corruption) applies PER ATTEMPT inside the
+        retried callable, so an injected drop exercises the same backoff
+        path a real outage would."""
+
+        def attempt(timeout: float) -> None:
+            inj = get_injector()
+            d = inj.wire_delay_s()
+            if d > 0:
+                time.sleep(d)  # runs on a to_thread worker, not the loop
+            if inj.should_drop():
+                raise ChaosDrop(
+                    f"chaos: dropped frame to {self.client.target}")
+            bad = inj.corrupt(payload)
+            fn(bad if bad is not None else payload, timeout=timeout)
+
+        await self._retry.call_async(
+            attempt, op_timeout=60.0, codes=codes,
+            on_retry=self._note_retry)
 
     async def stop(self) -> None:
         if self._task:
@@ -306,7 +488,7 @@ class DistRuntime(TopologyRuntime):
         set_worker_tag(worker_idx)
         self._wire_format = getattr(config.topology, "wire_format", "binary")
         self.senders: Dict[int, PeerSender] = {
-            idx: PeerSender(addr, self._wire_format)
+            idx: self._make_sender(idx, addr)
             for idx, addr in peers.items() if idx != worker_idx
         }
         self.ledger = DistLedger(
@@ -314,6 +496,70 @@ class DistRuntime(TopologyRuntime):
             worker_idx,
             self.senders,
         )
+        self._reroute_rr = 0  # round-robin cursor for reroute_tuple
+        # Arm the process-wide chaos injector from [chaos] (no-op unless
+        # enabled) so submit-recipe chaos reaches every worker.
+        install_chaos(getattr(config, "chaos", None), flight=self.flight)
+
+    def _make_sender(self, idx: int, addr: str) -> PeerSender:
+        sender = PeerSender(addr, self._wire_format,
+                            resilience=self.config.resilience)
+        sender.bind_obs(self.metrics, self.flight, idx)
+        sender.set_reroute(
+            lambda c, i, t, _s=sender: self.reroute_tuple(c, i, t, _s))
+        sender._pace_rate_fn = self._replay_rate
+        return sender
+
+    def _replay_rate(self) -> float:
+        """Tuples/s budget for post-recovery replay pacing.
+
+        ``resilience.replay_rate`` wins when set; otherwise the auto rate
+        drains one full ``max_spout_pending`` window per
+        ``replay_window_s``, clamped by the bottleneck verdict's leader
+        capacity when the observatory has one — no point replaying faster
+        than the topology's measured ceiling."""
+        res = self.config.resilience
+        if res.replay_rate > 0:
+            return res.replay_rate
+        pending = max(1, int(self.config.topology.max_spout_pending or 1))
+        rate = pending / max(0.1, res.replay_window_s)
+        verdict = getattr(getattr(self, "obs", None), "bottleneck", None)
+        verdict = getattr(verdict, "last_verdict", None)
+        if isinstance(verdict, dict):
+            leader = verdict.get("leader")
+            for row in verdict.get("ranked") or []:
+                if row.get("component") == leader:
+                    cap = float(row.get("capacity") or 0.0)
+                    if cap > 0:
+                        rate = min(rate, cap)
+                    break
+        return rate
+
+    async def reroute_tuple(self, component: str, task: int, t: Tuple,
+                            dead_sender: PeerSender) -> bool:
+        """Try to land a tuple parked behind an open circuit on a SURVIVING
+        task of the same component. Only legal when every subscription into
+        the component is shuffle-family (LocalOrShuffle included): fields/
+        all/direct groupings pin tuples to their chosen task, so those park
+        instead. Returns True when re-delivered."""
+        from storm_tpu.runtime.groupings import ShuffleGrouping
+
+        spec = self.topology.specs.get(component)
+        group = self.groups.get(component)
+        if spec is None or group is None:
+            return False
+        if not all(isinstance(sub.grouping, ShuffleGrouping)
+                   for sub in spec.inputs):
+            return False
+        survivors = [
+            inbox for inbox in group.inboxes
+            if getattr(inbox, "_sender", None) is not dead_sender
+        ]
+        if not survivors:
+            return False
+        self._reroute_rr = (self._reroute_rr + 1) % len(survivors)
+        await survivors[self._reroute_rr].put(t)
+        return True
 
     def _local(self, component_id: str) -> bool:
         return self.placement.get(component_id, 0) == self.worker_idx
@@ -368,9 +614,16 @@ class DistRuntime(TopologyRuntime):
         in flight anyway, and the spout ledger's timeout replays their trees
         (at-least-once, same story as a worker crash under Storm)."""
         old = self.senders.get(idx)
-        sender = PeerSender(addr, self._wire_format)
+        sender = self._make_sender(idx, addr)
         self.senders[idx] = sender
         sender.start()
+        # The replacement is cold (fresh process, unwarmed engines): pace
+        # the replay burst that is about to hit it, same as a circuit
+        # close, and leave a flight-recorder breadcrumb for the bench.
+        sender.begin_recovery_pacing(self._replay_rate(),
+                                     self.config.resilience.replay_window_s)
+        if self.flight is not None:
+            self.flight.event("dist_peer_replaced", idx=idx, addr=addr)
         for spec in self.topology.specs.values():
             if spec.is_spout or self._local(spec.component_id):
                 continue
@@ -428,7 +681,18 @@ class DistRuntime(TopologyRuntime):
     # ---- inbound (called from gRPC threads) ----------------------------------
 
     def deliver_threadsafe(self, payload: bytes, loop: asyncio.AbstractEventLoop) -> None:
-        deliveries = transport.decode_deliveries(payload)
+        try:
+            deliveries = transport.decode_deliveries(payload)
+        except wire.WireError as e:
+            # Corrupted frame (CRC/structure): account it, then let the
+            # RPC fail — the SENDER treats the resulting UNKNOWN status as
+            # non-retryable (same bytes, same CRC), so the affected trees
+            # time out and replay from the spout.
+            self.metrics.counter("_transport", "dist_wire_errors").inc()
+            if self.flight is not None:
+                self.flight.event("wire_error", error=str(e),
+                                  nbytes=len(payload), throttle_s=0.5)
+            raise
 
         async def enqueue():
             for component, task, t in deliveries:
@@ -564,6 +828,17 @@ class WorkerServer:
                 {int(k): v for k, v in req["peers"].items()},
             )
             return {"ok": True}
+        if cmd == "chaos":
+            # Live fault injection (bench/chaos drills): set any subset of
+            # the injector knobs; always returns the full knob + counter
+            # snapshot so callers can read evidence without arming anything.
+            inj = get_injector()
+            if self.rt is not None:
+                inj.bind_flight(self.rt.flight)
+            knobs = {k: v for k, v in req.items() if k != "cmd"}
+            if knobs:
+                inj.configure(**knobs)
+            return {"ok": True, "chaos": inj.snapshot()}
         assert self.rt is not None, "submit first"
         if cmd == "start_bolts":
             self._run_on_loop(self.rt.start_bolts())

@@ -150,6 +150,22 @@ class StagingPool:
                     "limit": self.limit}
 
 
+class EngineWatchdogTimeout(RuntimeError):
+    """A batch overran ``batch.watchdog_ms`` on the fetch ring.
+
+    Raised on the fetch thread INSIDE the per-batch try, so it rides the
+    existing isolation path: only the stuck batch's future fails (its
+    sources replay) and the ring/staging slots are released — the device
+    program may still be running, but the pipeline stops waiting on it."""
+
+
+class EngineQuarantined(RuntimeError):
+    """Dispatch refused: this engine tripped its watchdog
+    ``batch.watchdog_trips`` times in a row and is quarantined. Callers
+    fail the batch (sources replay) until the operator swaps in a
+    replacement engine (see InferenceOperator's on_quarantine hook)."""
+
+
 class InflightBatch:
     """Handle for one batch inside the split-phase pipeline.
 
@@ -165,7 +181,7 @@ class InflightBatch:
     """
 
     __slots__ = ("future", "n", "padded", "timings", "profile_key", "_out",
-                 "_buf", "_t_launched")
+                 "_buf", "_t_launched", "watchdog_ms", "on_done")
 
     def __init__(self, n: int, padded: int) -> None:
         self.future: Future = Future()
@@ -178,6 +194,12 @@ class InflightBatch:
         self._out = None  # device array, dropped after fetch
         self._buf = None  # staging buffer, recycled after fetch
         self._t_launched = 0.0
+        # Watchdog contract (set by dispatch): fetch waits at most
+        # watchdog_ms (0 = forever) and reports the outcome to on_done —
+        # a bound engine method, so the handle pins the engine only while
+        # this batch is in flight (the fetch THREAD still holds no ref).
+        self.watchdog_ms = 0.0
+        self.on_done = None
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         return self.future.result(timeout)
@@ -196,7 +218,7 @@ def _fetch_loop(fetch_q: "queue.SimpleQueue", ring: threading.Semaphore,
         if handle is None:
             return
         try:
-            handle._out.block_until_ready()
+            _watchdog_wait(handle)
             t1 = time.perf_counter()
             res = np.asarray(handle._out)
             t2 = time.perf_counter()
@@ -218,11 +240,81 @@ def _fetch_loop(fetch_q: "queue.SimpleQueue", ring: threading.Semaphore,
         except BaseException as e:  # noqa: BLE001 - fail ONLY this batch
             handle._out = None
             handle.future.set_exception(e)
+            _notify_done(handle, e)
+        else:
+            _notify_done(handle, None)
         finally:
             buf, handle._buf = handle._buf, None
             if buf is not None:
                 staging.release(buf)
             ring.release()
+
+
+def _watchdog_wait(handle: InflightBatch) -> None:
+    """Wait for the batch's device result, bounded by ``watchdog_ms``.
+
+    With no deadline (or a result object that can't report readiness)
+    this is the plain blocking wait. With one, poll ``is_ready()`` —
+    jax.Array exposes it without blocking — and raise
+    :class:`EngineWatchdogTimeout` past the deadline so the stuck batch
+    fails alone instead of wedging the whole fetch ring behind it."""
+    out = handle._out
+    ms = handle.watchdog_ms
+    is_ready = getattr(out, "is_ready", None)
+    if ms <= 0 or is_ready is None:
+        out.block_until_ready()
+        return
+    deadline = time.monotonic() + ms / 1e3
+    while not is_ready():
+        if time.monotonic() > deadline:
+            raise EngineWatchdogTimeout(
+                f"batch (n={handle.n}, padded={handle.padded}) exceeded "
+                f"watchdog_ms={ms:g} on the fetch ring")
+        time.sleep(min(0.002, ms / 1e4))
+    out.block_until_ready()
+
+
+class _HangingResult:
+    """Chaos wrapper: a device result that refuses to report ready until
+    its hold expires (:meth:`ChaosInjector.engine_hang_s`) — gives the
+    fetch-ring watchdog a genuinely stuck batch to catch without having
+    to wedge a real device program."""
+
+    __slots__ = ("_inner", "_until")
+
+    def __init__(self, inner, until: float) -> None:
+        self._inner = inner
+        self._until = until
+
+    def is_ready(self) -> bool:
+        if time.monotonic() < self._until:
+            return False
+        ir = getattr(self._inner, "is_ready", None)
+        return True if ir is None else ir()
+
+    def block_until_ready(self):
+        rem = self._until - time.monotonic()
+        if rem > 0:
+            time.sleep(rem)
+        bur = getattr(self._inner, "block_until_ready", None)
+        if bur is not None:
+            bur()
+        return self
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._inner)
+        return a if dtype is None else a.astype(dtype, copy=False)
+
+
+def _notify_done(handle: InflightBatch, exc) -> None:
+    cb = handle.on_done
+    handle.on_done = None  # drop the engine ref with the batch
+    if cb is None:
+        return
+    try:
+        cb(exc)
+    except Exception:
+        pass  # a watchdog accounting hook must never fail the loop
 
 
 # ---- cost-profile sink (storm_tpu/obs/profile.py) ----------------------------
@@ -367,6 +459,15 @@ class InferenceEngine:
         # Dispatch slots visible to the continuous batcher: ring depth when
         # pipelined, else the single serialized predict slot.
         self.ring_capacity = max(1, self.pipeline_depth)
+        # Watchdog / quarantine state (batch.watchdog_ms, watchdog_trips):
+        # consecutive fetch-deadline trips counted on the fetch thread via
+        # the handle's on_done hook; at the threshold the engine flips to
+        # quarantined (dispatch raises EngineQuarantined) and fires
+        # on_quarantine exactly once so the operator can swap a fresh one.
+        self.quarantined = False
+        self.on_quarantine = None
+        self._watchdog_trips = 0
+        self._watchdog_lock = threading.Lock()
 
         params, state = load_or_init(self.model, model_cfg.checkpoint, model_cfg.seed)
         if self.ep > 1:
@@ -613,9 +714,17 @@ class InferenceEngine:
         pipeline disabled it degrades to the serialized predict wrapped
         in an already-resolved handle.
         """
+        if self.quarantined:
+            raise EngineQuarantined(
+                f"engine {self.model_cfg.name!r} is quarantined after "
+                f"{self._watchdog_trips} consecutive watchdog trips")
         n = sum(int(p.shape[0]) for p in parts)
         handle = InflightBatch(n, self.pad_batch(n))
         handle.profile_key = self.profile_key
+        wd = float(getattr(self.batch_cfg, "watchdog_ms", 0.0) or 0.0)
+        if wd > 0:
+            handle.watchdog_ms = wd
+            handle.on_done = self._watchdog_note
         if self._ring is None:
             x = parts[0] if len(parts) == 1 else np.concatenate(parts)
             try:
@@ -700,11 +809,63 @@ class InferenceEngine:
                     self.on_compile(padded, (t1 - t0) * 1e3)
                 except Exception:
                     pass  # an observability hook must never fail a batch
+        hold = self._chaos_hang_s()
+        if hold > 0:
+            out = _HangingResult(out, time.monotonic() + hold)
         handle._out = out
         handle._t_launched = t1
         # Staging + H2D + async launch (plus XLA compile when cold — the
         # on_compile event disambiguates the cliff in a post-mortem).
         handle.timings["h2d_ms"] = (t1 - t0) * 1e3
+
+    @staticmethod
+    def _chaos_hang_s() -> float:
+        """One-shot engine-hang injection (chaos control RPC); 0 when the
+        injector is unarmed — the common case pays one global read."""
+        from storm_tpu.resilience.chaos import get_injector
+
+        return get_injector().engine_hang_s()
+
+    def _watchdog_note(self, exc) -> None:
+        """Fetch-thread callback (InflightBatch.on_done): count
+        CONSECUTIVE watchdog trips; at ``batch.watchdog_trips`` flip to
+        quarantined exactly once, fire ``on_quarantine`` (the operator's
+        replacement hook) and evict this engine from the shared cache so
+        the next ``shared_engine`` call builds a fresh one."""
+        if not isinstance(exc, EngineWatchdogTimeout):
+            # A hung batch that eventually lands still reports success
+            # here — keep the trip count once quarantined so the
+            # fail-fast message names the real streak.
+            if exc is None and not self.quarantined:
+                with self._watchdog_lock:
+                    self._watchdog_trips = 0
+            return
+        limit = int(getattr(self.batch_cfg, "watchdog_trips", 0) or 0)
+        with self._watchdog_lock:
+            self._watchdog_trips += 1
+            trips = self._watchdog_trips
+            if limit <= 0 or trips < limit or self.quarantined:
+                return
+            self.quarantined = True
+        logger.error(
+            "engine %s QUARANTINED after %d consecutive watchdog trips "
+            "(watchdog_ms=%g); dispatch now refuses batches until a "
+            "replacement is swapped in",
+            self.model_cfg.name, trips, getattr(self.batch_cfg,
+                                                "watchdog_ms", 0.0))
+        # Evict BEFORE the replacement hook: the hook rebuilds via
+        # shared_engine off-thread, and a cache hit on the still-cached
+        # quarantined engine would "swap in" the dead engine forever.
+        try:
+            unload_engine(self)
+        except Exception:
+            logger.exception("evicting quarantined engine failed")
+        cb = self.on_quarantine
+        if cb is not None:
+            try:
+                cb(trips)
+            except Exception:
+                logger.exception("on_quarantine hook failed")
 
     def _ensure_fetch_thread(self) -> None:
         if self._fetch_thread is not None:

@@ -264,6 +264,19 @@ class InferenceBolt(Bolt):
                         rt.engine.on_compile = hook
                     except AttributeError:
                         pass  # slotted test double
+        # Engine quarantine -> replacement (batch.watchdog_trips): the
+        # watchdog quarantines on the fetch thread; this hook records it
+        # and rebuilds a fresh shared engine on a background thread (the
+        # quarantined one was evicted from the cache), swapping it in once
+        # warmed. Until then dispatch raises EngineQuarantined, those
+        # batches fail, and their sources replay — fail-and-replay, never
+        # wedge.
+        self._m_quarantined = m.gauge(cid, "engine_quarantined")
+        self._m_wd_trips = m.counter(cid, "watchdog_trips")
+        try:
+            self.engine.on_quarantine = self._engine_quarantined
+        except AttributeError:
+            pass  # slotted test double
         # Continuous batching (BatchGen, ROADMAP item 3): batch formation
         # moves OFF this task into the engine's shared slot-level queue —
         # every replica, the serve cross-batcher, and cascade residues
@@ -302,6 +315,65 @@ class InferenceBolt(Bolt):
             self._cb_room = asyncio.Event()
             self._cb_room.set()
             self._cb_source = f"{cid}#{context.task_index}"
+
+    # ---- quarantine -> replacement -------------------------------------------
+
+    def _engine_quarantined(self, trips: int) -> None:
+        """Engine watchdog callback (fires ONCE, on the fetch thread):
+        record the quarantine, then prewarm a replacement off-thread and
+        swap it in. Batches dispatched in between fail fast
+        (EngineQuarantined) and their sources replay."""
+        import threading
+
+        self._m_quarantined.set(1)
+        self._m_wd_trips.inc(trips)
+        if self._flight is not None:
+            self._flight.event(
+                "engine_quarantined", component=self.context.component_id,
+                model=self.model_cfg.name, trips=trips)
+        old = self.engine
+
+        def rebuild() -> None:
+            try:
+                # The quarantined engine was evicted from the shared
+                # cache, so this builds (and warms) a genuinely fresh one.
+                eng = shared_engine(
+                    self.model_cfg, self.sharding_cfg, self.batch_cfg)
+                if self._warmup:
+                    eng.warmup()
+                try:
+                    eng.on_compile = old.on_compile
+                    eng.on_quarantine = self._engine_quarantined
+                except AttributeError:
+                    pass
+                self.engine = eng
+                # Re-aim the continuous batcher (it holds the engine it
+                # dispatches to) at the replacement.
+                if getattr(self, "_cbs", None) and None in self._cbs:
+                    from storm_tpu.infer.continuous import continuous_for
+
+                    cb = continuous_for(eng, self.batch_cfg, self.qos)
+                    m = self.context.metrics
+                    cb.bind(m, self.context.component_id,
+                            tracer=self._tracer, flight=self._flight,
+                            trace_of=lambda p: self._anchor_of(p).trace,
+                            span_name="device_execute")
+                    self._cbs[None] = cb
+                self._m_quarantined.set(0)
+                if self._flight is not None:
+                    self._flight.event(
+                        "engine_replaced",
+                        component=self.context.component_id,
+                        model=self.model_cfg.name)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "replacement engine build failed; component stays "
+                    "quarantined (batches fail fast and replay)")
+
+        threading.Thread(target=rebuild, name="engine-replace",
+                         daemon=True).start()
 
     # ---- ingest --------------------------------------------------------------
 

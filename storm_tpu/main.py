@@ -1157,6 +1157,36 @@ def main(argv=None) -> int:
                     ui_loop,
                 ).result(timeout=10)
                 print(f"ui http://127.0.0.1:{ui.port}", file=sys.stderr)
+            chaos_thread = None
+            if cfg.chaos.enabled and cfg.chaos.kill_worker_s > 0:
+                # Chaos drill ([chaos] kill_worker_s): SIGKILL a random
+                # non-controller worker every interval; the heartbeat
+                # monitor detects and recovers it. Wire/corruption knobs
+                # already rode the submit recipe into every worker.
+                import random as _random
+                import threading
+
+                cluster.start_monitor()
+                stop_chaos = threading.Event()
+                rng = _random.Random(cfg.chaos.seed)
+
+                def kill_loop() -> None:
+                    while not stop_chaos.wait(cfg.chaos.kill_worker_s):
+                        live = [i for i, p in enumerate(cluster.procs)
+                                if p is not None and p.poll() is None]
+                        if len(live) < 2:
+                            continue  # never kill the last worker standing
+                        victim = rng.choice(live[1:])  # spare the spout host
+                        print(f"chaos: SIGKILL worker {victim}",
+                              file=sys.stderr)
+                        cluster.flight.event("chaos_injection",
+                                             target="worker_kill",
+                                             worker=victim)
+                        cluster.procs[victim].kill()
+
+                chaos_thread = threading.Thread(
+                    target=kill_loop, name="chaos-kill", daemon=True)
+                chaos_thread.start()
             try:
                 if args.duration > 0:
                     time.sleep(args.duration)
@@ -1164,6 +1194,10 @@ def main(argv=None) -> int:
                     signal.sigwait({signal.SIGINT, signal.SIGTERM})
             except KeyboardInterrupt:
                 pass
+            if chaos_thread is not None:
+                stop_chaos.set()
+                chaos_thread.join(timeout=5)
+                cluster.stop_monitor()
             if ui is not None:
                 asyncio.run_coroutine_threadsafe(ui.stop(), ui_loop).result(timeout=10)
                 ui_loop.call_soon_threadsafe(ui_loop.stop)

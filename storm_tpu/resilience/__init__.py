@@ -1,0 +1,43 @@
+"""Resilience primitives for the mesh (round 14).
+
+The dist layer's original failure story was inherited wholesale from the
+ack-ledger: any transport hiccup burned the whole tuple tree and waited
+out ``message_timeout_s``. This package adds the three mechanisms that
+let the mesh degrade instead of cliff-diving, plus the fault injector
+that proves they work:
+
+- :mod:`retry` — deadline-budgeted retries with exponential backoff and
+  full jitter, gRPC status-code classification (UNAVAILABLE retries,
+  UNAUTHENTICATED fails fast).
+- :mod:`circuit` — per-peer circuit breaker (closed -> open on
+  consecutive failures, half-open probe on a timer).
+- :mod:`tokens` — token bucket; paces post-recovery replay drains so a
+  returning worker is not flattened by a replay storm.
+- :mod:`chaos` — process-wide fault injector (wire latency/drop, frame
+  corruption, engine hangs) driven by ``[chaos]`` config or the worker
+  ``chaos`` control RPC; every injection is a ``chaos_injection``
+  flight event.
+"""
+
+from storm_tpu.resilience.chaos import (ChaosDrop, ChaosInjector,
+                                        get_injector, install_chaos)
+from storm_tpu.resilience.circuit import CircuitBreaker
+from storm_tpu.resilience.retry import (FATAL_CODES, RETRYABLE_BROAD,
+                                        RETRYABLE_NARROW, RetryPolicy,
+                                        is_fatal_rpc, is_retryable)
+from storm_tpu.resilience.tokens import TokenBucket
+
+__all__ = [
+    "CircuitBreaker",
+    "ChaosDrop",
+    "ChaosInjector",
+    "FATAL_CODES",
+    "RETRYABLE_BROAD",
+    "RETRYABLE_NARROW",
+    "RetryPolicy",
+    "TokenBucket",
+    "get_injector",
+    "install_chaos",
+    "is_fatal_rpc",
+    "is_retryable",
+]

@@ -1,0 +1,175 @@
+"""Deadline-budgeted retry with exponential backoff + full jitter.
+
+Classification is the heart of it: a retry layer that re-sends on every
+exception turns a bad control token into 30 s of silent spinning (the
+``wait_ready`` bug this round fixes) and can double-apply non-idempotent
+ops. Codes split three ways:
+
+- ``RETRYABLE_BROAD`` — safe for idempotent-or-reconcilable ops
+  (Control, Ack): UNAVAILABLE / DEADLINE_EXCEEDED / RESOURCE_EXHAUSTED /
+  ABORTED. An Ack batch re-applied after a DEADLINE_EXCEEDED that
+  actually landed can only re-toggle xor parity — the tree then times
+  out and replays (at-least-once preserved), it can never falsely
+  complete.
+- ``RETRYABLE_NARROW`` — Deliver only: UNAVAILABLE alone. UNAVAILABLE is
+  raised before the request reaches the application handler ("before
+  first byte acked"), so a resend cannot double-enqueue; a timed-out
+  Deliver MAY have been enqueued, so it is left to ledger-timeout replay
+  instead of being re-sent.
+- ``FATAL_CODES`` — UNAUTHENTICATED / PERMISSION_DENIED /
+  INVALID_ARGUMENT / UNIMPLEMENTED / FAILED_PRECONDITION: retrying
+  cannot help; fail fast so the caller sees the real error immediately.
+
+``ConnectionError``/``OSError`` (plain sockets, e.g. broker adapters)
+count as retryable; any other exception type is a bug in the caller, not
+weather, and propagates on the first attempt.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, FrozenSet, Optional
+
+import grpc
+
+RETRYABLE_BROAD: FrozenSet[grpc.StatusCode] = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+    grpc.StatusCode.ABORTED,
+})
+
+#: Deliver is idempotent-safe only before the first byte reached the
+#: handler; UNAVAILABLE is the one code that guarantees that.
+RETRYABLE_NARROW: FrozenSet[grpc.StatusCode] = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+})
+
+FATAL_CODES: FrozenSet[grpc.StatusCode] = frozenset({
+    grpc.StatusCode.UNAUTHENTICATED,
+    grpc.StatusCode.PERMISSION_DENIED,
+    grpc.StatusCode.INVALID_ARGUMENT,
+    grpc.StatusCode.UNIMPLEMENTED,
+    grpc.StatusCode.FAILED_PRECONDITION,
+})
+
+
+def _rpc_code(exc: BaseException) -> Optional[grpc.StatusCode]:
+    if not isinstance(exc, grpc.RpcError):
+        return None
+    code = getattr(exc, "code", None)
+    if code is None:
+        return None
+    try:
+        return code()
+    except Exception:
+        return None
+
+
+def is_fatal_rpc(exc: BaseException) -> bool:
+    """True when the RPC failed for a reason retrying cannot fix
+    (auth, malformed request, unimplemented method)."""
+    return _rpc_code(exc) in FATAL_CODES
+
+
+def is_retryable(exc: BaseException,
+                 codes: FrozenSet[grpc.StatusCode] = RETRYABLE_BROAD) -> bool:
+    code = _rpc_code(exc)
+    if code is not None:
+        return code in codes
+    # Non-gRPC transports (sockets): connection weather retries; anything
+    # else is a programming error and must surface immediately.
+    return isinstance(exc, (ConnectionError, OSError))
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff + full jitter under a total deadline budget.
+
+    ``attempts`` bounds the count, ``deadline_s`` bounds the wall clock
+    across ALL attempts (including their sleeps); whichever runs out
+    first ends the loop with the last exception. Full jitter
+    (``uniform(0, min(cap, base * 2^n))``, the AWS-architecture variant)
+    decorrelates a fleet of senders hammering one recovering peer.
+    """
+
+    attempts: int = 4
+    base_s: float = 0.05
+    cap_s: float = 2.0
+    deadline_s: float = 30.0
+    _rng: random.Random = field(default_factory=random.Random, repr=False)
+
+    def backoff(self, attempt: int) -> float:
+        return self._rng.uniform(0.0, min(self.cap_s,
+                                          self.base_s * (2 ** attempt)))
+
+    def _plan(self, op_timeout: Optional[float]) -> float:
+        budget = self.deadline_s
+        if op_timeout is not None:
+            budget = min(budget, max(op_timeout, 0.001))
+        return time.monotonic() + budget
+
+    def _next_delay(self, attempt: int, exc: BaseException,
+                    deadline: float,
+                    codes: FrozenSet[grpc.StatusCode],
+                    on_retry) -> float:
+        """Decide whether attempt ``attempt`` may be retried; returns the
+        jittered sleep, or re-raises ``exc`` when out of budget/attempts
+        or the failure is non-retryable."""
+        remaining = deadline - time.monotonic()
+        if (attempt >= self.attempts - 1 or remaining <= 0
+                or not is_retryable(exc, codes)):
+            raise exc
+        if on_retry is not None:
+            try:
+                on_retry(attempt, exc)
+            except Exception:
+                pass
+        return min(self.backoff(attempt), max(remaining, 0.0))
+
+    def call_sync(self, fn: Callable[[Optional[float]], Any], *,
+                  op_timeout: Optional[float] = None,
+                  codes: FrozenSet[grpc.StatusCode] = RETRYABLE_BROAD,
+                  on_retry: Optional[Callable[[int, BaseException],
+                                              None]] = None) -> Any:
+        """Blocking variant (sleeps with ``time.sleep`` — taught to the
+        lint blocking-call table; never call under a lock). ``fn``
+        receives the per-attempt timeout: the remaining deadline budget,
+        further capped by ``op_timeout``."""
+        deadline = self._plan(op_timeout)
+        attempt = 0
+        while True:
+            remaining = max(deadline - time.monotonic(), 0.001)
+            t = remaining if op_timeout is None else min(op_timeout, remaining)
+            try:
+                return fn(t)
+            except Exception as e:
+                delay = self._next_delay(attempt, e, deadline, codes,
+                                         on_retry)
+            time.sleep(delay)
+            attempt += 1
+
+    async def call_async(self, fn: Callable[[Optional[float]], Any], *,
+                         op_timeout: Optional[float] = None,
+                         codes: FrozenSet[grpc.StatusCode] = RETRYABLE_BROAD,
+                         on_retry: Optional[Callable[[int, BaseException],
+                                                     None]] = None) -> Any:
+        """Event-loop variant: ``fn`` (a blocking callable taking the
+        per-attempt timeout) runs on a worker thread; backoff sleeps are
+        ``asyncio.sleep`` so the loop keeps serving other peers."""
+        import asyncio
+
+        deadline = self._plan(op_timeout)
+        attempt = 0
+        while True:
+            remaining = max(deadline - time.monotonic(), 0.001)
+            t = remaining if op_timeout is None else min(op_timeout, remaining)
+            try:
+                return await asyncio.to_thread(fn, t)
+            except Exception as e:
+                delay = self._next_delay(attempt, e, deadline, codes,
+                                         on_retry)
+            await asyncio.sleep(delay)
+            attempt += 1

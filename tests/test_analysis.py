@@ -553,6 +553,25 @@ def test_config_from_pyproject(tmp_path):
 def test_repo_config_has_grpc_blocking_methods():
     cfg = load_config(ROOT)
     assert "control" in cfg.blocking_methods
+    # Round-14 retry/backoff wrappers: a deadline-budgeted retry loop can
+    # sleep for SECONDS — under a lock that is a pipeline-wide stall, so
+    # the repo config must keep them in the blocking-call table.
+    for m in ("call_sync", "throttle_sync", "wait_ready"):
+        assert m in cfg.blocking_methods, m
+
+
+def test_lck001_retry_loop_under_lock():
+    """A retry wrapper invoked while holding a lock is an LCK001 finding
+    with the repo's configured blocking-method table."""
+    src = """
+        class C:
+            def f(self):
+                with self._lock:
+                    self._retry.call_sync(self._send, b"x")
+    """
+    assert lint(src) == []  # unknown method without the table
+    fs = lint(src, blocking_methods=load_config(ROOT).blocking_methods)
+    assert rules_of(fs) == {"LCK001"}
 
 
 def test_cli_json_schema(capsys):

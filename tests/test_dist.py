@@ -346,8 +346,218 @@ def test_dist_worker_failure_recovery():
             health = cluster.health()
             assert health[1]["components"]["inference-bolt"]["alive"] == 2
 
+            # Round-14 transport evidence: the outage must have flowed
+            # through the retry -> circuit-open -> park path on the spout
+            # host (never a silent drop), and the controller must have
+            # accounted every missed heartbeat.
+            transport = cluster.metrics().get("_transport", {})
+            assert transport.get("dist_send_retries", 0) >= 1
+            assert transport.get("dist_circuit_opens", 0) >= 1
+            assert transport.get("dist_parked_batches", 0) >= 1
+            ctrl = cluster.ctrl_metrics.snapshot().get("controller", {})
+            assert ctrl.get("dist_heartbeat_miss", 0) >= 2
+            kinds = {ev["kind"] for ev in cluster.flight.tail(100)}
+            assert "dist_heartbeat_miss" in kinds
+            assert "dist_worker_recovered" in kinds
+
             cluster.stop_monitor()
             cluster.kill()
+    finally:
+        stub.close()
+
+
+def test_dist_chaos_frame_corruption_replays():
+    """Arm the wire-corruption injector on the spout host: the flipped
+    frames must fail the binary wire's CRC on the receiving worker
+    (``dist_wire_errors`` + a ``wire_error`` flight event), the sender
+    must treat the UNKNOWN status as non-retryable (same bytes, same
+    CRC), and the affected trees must replay from the spout so every
+    record still comes out — corruption is loss, never wrong data."""
+    stub = KafkaStubBroker(partitions=1)
+    try:
+        cfg = Config()
+        cfg.broker.kind = "kafka"
+        cfg.broker.bootstrap = f"127.0.0.1:{stub.port}"
+        cfg.broker.input_topic = "crc-in"
+        cfg.broker.output_topic = "crc-out"
+        cfg.model.name = "lenet5"
+        cfg.model.dtype = "float32"
+        cfg.model.input_shape = (28, 28, 1)
+        cfg.offsets.policy = "earliest"
+        cfg.offsets.max_behind = None
+        cfg.batch.max_batch = 4
+        cfg.batch.max_wait_ms = 20
+        cfg.batch.buckets = (4,)
+        cfg.topology.spout_parallelism = 1
+        cfg.topology.inference_parallelism = 1
+        cfg.topology.sink_parallelism = 1
+        cfg.topology.wire_format = "binary"  # the CRC under test
+        # Short tree timeout: corrupted-frame trees must replay quickly.
+        cfg.topology.message_timeout_s = 6.0
+
+        placement = {
+            "kafka-spout": 0,
+            "inference-bolt": 1,
+            "kafka-bolt": 0,
+            "dlq-bolt": 0,
+        }
+        n_msgs = 8
+        rng = np.random.RandomState(3)
+        with DistCluster(2, env={"JAX_PLATFORMS": "cpu",
+                                 "STORM_TPU_PLATFORM": "cpu"}) as cluster:
+            cluster.submit("crc-e2e", cfg, placement)
+            # Two one-shot corruptions on worker 0's outbound frames (the
+            # spout->inference deliveries; budget, not pct, so the test is
+            # deterministic in HOW MANY frames get hit).
+            resp = cluster.clients[0].control("chaos", corrupt_next=2)
+            assert resp["chaos"]["corrupt_next"] == 2
+
+            from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+            producer = KafkaWireBroker(cfg.broker.bootstrap)
+            for _ in range(n_msgs):
+                x = rng.rand(1, 28, 28, 1).astype(np.float32)
+                producer.produce("crc-in",
+                                 json.dumps({"instances": x.tolist()}))
+
+            deadline = time.time() + 120
+            while time.time() < deadline and stub.topic_size("crc-out") < n_msgs:
+                time.sleep(0.2)
+            # Every record survives the corruption (replay, not loss).
+            assert stub.topic_size("crc-out") >= n_msgs
+
+            # The injector fired and its budget is spent.
+            snap0 = cluster.clients[0].control("chaos")["chaos"]
+            assert snap0["corrupt_next"] == 0
+            assert snap0["counts"].get("frame_corruption", 0) == 2
+            # The receiver accounted the CRC failures (a flip could by
+            # luck land in the tiny frame header instead — then the RPC
+            # still fails and the tree still replays, but the WireError
+            # counter stays low; >= 1 of 2 keeps the test honest without
+            # betting on both byte positions).
+            w1 = cluster.clients[1].control("metrics")["metrics"]
+            assert w1.get("_transport", {}).get("dist_wire_errors", 0) >= 1
+            flight1 = cluster.clients[1].control("traces", n=50)
+            kinds = {ev["kind"] for ev in flight1.get("flight") or []}
+            assert "wire_error" in kinds
+            # The corrupted batches' trees replayed from the spout.
+            spout_m = cluster.metrics().get("kafka-spout", {})
+            assert spout_m.get("tree_failed", 0) >= 1
+            producer.close()
+    finally:
+        stub.close()
+
+
+def test_dist_eos_no_duplicates_across_worker_kill():
+    """Exactly-once ACROSS a worker crash: kill the inference worker
+    mid-stream on the offsets-in-transaction topology. The sink parks
+    every fan-out tree until the ledger shows the whole tree in its
+    hands, so a tree interrupted by the crash never half-commits — after
+    recovery + replay a read_committed consumer must see each input
+    exactly once (replays may abort transactions, never duplicate
+    committed records)."""
+    stub = KafkaStubBroker(partitions=2)
+    try:
+        cfg = Config()
+        cfg.broker.kind = "kafka"
+        cfg.broker.bootstrap = f"127.0.0.1:{stub.port}"
+        cfg.broker.message_format = "v2"
+        cfg.broker.input_topic = "eosk-in"
+        cfg.broker.output_topic = "eosk-out"
+        cfg.broker.dead_letter_topic = "eosk-dlq"
+        cfg.model.name = "lenet5"
+        cfg.model.dtype = "float32"
+        cfg.model.input_shape = (28, 28, 1)
+        cfg.offsets.policy = "txn"
+        cfg.offsets.group_id = "eosk"
+        cfg.offsets.max_behind = None
+        cfg.sink.mode = "transactional"
+        cfg.sink.txn_batch = 4
+        cfg.sink.txn_ms = 30.0
+        cfg.sink.offsets_group = "eosk"
+        cfg.batch.max_batch = 8
+        cfg.batch.max_wait_ms = 20
+        cfg.batch.buckets = (8,)
+        cfg.topology.spout_parallelism = 1
+        cfg.topology.inference_parallelism = 1
+        cfg.topology.sink_parallelism = 1
+        # Trees stranded in the killed worker must replay fast.
+        cfg.topology.message_timeout_s = 10.0
+
+        placement = {
+            "kafka-spout": 0,
+            "inference-bolt": 1,
+            "kafka-bolt": 2,
+            "dlq-bolt": 2,
+        }
+        n_msgs = 12
+        rng = np.random.RandomState(5)
+        with DistCluster(3, env={"JAX_PLATFORMS": "cpu",
+                                 "STORM_TPU_PLATFORM": "cpu"}) as cluster:
+            cluster.submit("eosk", cfg, placement)
+            cluster.start_monitor(interval_s=0.5, misses=2)
+
+            from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+            producer = KafkaWireBroker(cfg.broker.bootstrap,
+                                       message_format="v2")
+
+            def produce(lo, hi):
+                for i in range(lo, hi):
+                    x = rng.rand(1, 28, 28, 1).astype(np.float32)
+                    producer.produce("eosk-in",
+                                     json.dumps({"instances": x.tolist()}),
+                                     partition=i % 2)
+
+            # Healthy phase: some trees commit before the crash.
+            produce(0, 6)
+            deadline = time.time() + 120
+            while time.time() < deadline and stub.topic_size("eosk-out") < 2:
+                time.sleep(0.1)
+            assert stub.topic_size("eosk-out") >= 2
+
+            cluster.procs[1].kill()
+            produce(6, n_msgs)
+
+            # Read-committed audit loop: all n_msgs inputs exactly once.
+            def committed_records():
+                rc = KafkaWireBroker(cfg.broker.bootstrap,
+                                     message_format="v2",
+                                     isolation="read_committed")
+                try:
+                    got = []
+                    for p in range(2):
+                        off = 0
+                        while True:
+                            batch = rc.fetch("eosk-out", p, off,
+                                             max_records=500)
+                            if not batch:
+                                break
+                            got.extend(batch)
+                            off = batch[-1].offset + 1
+                    return got
+                finally:
+                    rc.close()
+
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                if len(committed_records()) >= n_msgs:
+                    break
+                time.sleep(0.5)
+            assert cluster.drain(timeout_s=60)
+            records = committed_records()
+            # Exactly once: no loss AND no duplicate committed emits,
+            # even though the crash forced tree replays.
+            assert len(records) == n_msgs, (
+                f"read_committed saw {len(records)} records for "
+                f"{n_msgs} inputs")
+            committed = {p: producer.committed("eosk", "eosk-in", p)
+                         for p in (0, 1)}
+            assert committed == {0: 6, 1: 6}, committed
+            snap = cluster.metrics()
+            assert snap["kafka-bolt"]["txn_commits"] >= 1
+            cluster.stop_monitor()
+            producer.close()
     finally:
         stub.close()
 

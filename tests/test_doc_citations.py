@@ -188,3 +188,67 @@ def test_plan_artifact_gates():
     assert art["plan"]["parallelism"] >= 1
     assert art["capture_session"].startswith("cap-")
     assert art["code_version"]
+
+
+def test_chaos_artifact_gates():
+    """BENCH_CHAOS_r14.json backs the round-14 resilience docs: a worker
+    SIGKILL plus a wire brownout under steady load on a 3-worker mesh,
+    with recovery to >=95% of pre-fault goodput at a measured
+    time-to-recover, a bounded replay count with token-bucket pacing
+    evidence, zero duplicate sink emits on the exactly-once path, and at
+    least one engine-hang quarantine whose replacement engine served —
+    all observable via flight events and the new transport metrics from
+    the same capture session."""
+    import json
+
+    art = json.loads((REPO / "BENCH_CHAOS_r14.json").read_text())
+    assert art["metric"] == "chaos_recovery_dist3_cpu"
+
+    # Recovery: >=95% of pre-fault goodput, with a measured clock.
+    assert art["recovered"] is True
+    assert art["recovery_ratio"] >= 0.95
+    assert art["time_to_recover_s"] > 0
+    assert art["baseline_goodput_msgs_s"] > 0
+    assert any(w["phase"] == "outage" for w in art["timeline"])
+
+    # The brownout must have been injected AND survived (goodput never
+    # hit a dead stop while latency/drop were armed).
+    brown = art["brownout"]
+    assert brown["survived"] is True
+    counts = brown["chaos_injection_counts"]
+    assert counts.get("wire_latency", 0) >= 1
+    assert counts.get("wire_drop", 0) >= 1
+
+    # Bounded replay with token-bucket evidence: the ledger replayed the
+    # dead worker's trees, within the pending-window bound, and the
+    # recovery pacer actually throttled the replay burst.
+    rep = art["replays"]
+    assert rep["tree_failed"] >= 1, "a worker died mid-stream: no replays?"
+    assert rep["bounded"] is True and rep["tree_failed"] <= rep["bound"]
+    assert art["replay_pacing"]["throttled"] >= 1
+
+    # The heartbeat monitor saw the death and recovered the worker.
+    assert art["monitor"]["heartbeat"]["dist_heartbeat_miss"] >= 2
+    kinds = {ev["kind"] for ev in art["flight"]["controller"]}
+    assert "dist_heartbeat_miss" in kinds
+    assert "dist_worker_recovered" in kinds
+    assert "chaos_injection" in kinds  # the kill itself left a breadcrumb
+
+    # Zero duplicate sink emits on the exactly-once (transactional) path.
+    eo = art["exactly_once"]
+    assert eo["exactly_once"] is True
+    assert eo["audit"]["echo_duplicated"] == 0
+    assert eo["audit"]["echo_missing"] == 0
+
+    # >=1 engine-hang quarantine, and the replacement engine served (the
+    # soak drained + audited clean AFTER the mid-run quarantine).
+    q = art["quarantine"]
+    assert q["engine_hangs_injected"] >= 1
+    assert q["watchdog"]["watchdog_trips"] >= 1
+    flight_kinds = {ev["kind"] for ev in q["watchdog"]["flight"]}
+    assert "engine_quarantined" in flight_kinds
+    assert "engine_replaced" in flight_kinds
+    assert q["replacement_served"] is True
+
+    assert art["capture_session"].startswith("cap-")
+    assert art["code_version"]

@@ -28,7 +28,7 @@ def test_cpu_soak_exactly_once():
     env = dict(os.environ, JAX_PLATFORMS="cpu", STORM_TPU_PLATFORM="cpu")
     out = subprocess.run(
         [sys.executable, "soak_harness.py",
-         "--seconds", "45", "--rate", "20", "--out", "-"],
+         "--seconds", "45", "--rate", "20", "--out", "-", "--chaos"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=390)
     assert out.returncode == 0, (
         f"soak harness failed its own exactly_once gate:\n"
@@ -45,3 +45,10 @@ def test_cpu_soak_exactly_once():
     # The churn events must actually have happened — a quiet run that
     # audited clean proves much less than a churned one.
     assert artifact["events"], "soak ran without any fault/chaos events"
+    # --chaos phase: the engine-hang injection must have fired (the
+    # watchdog/quarantine arc it drives is what makes the clean audit
+    # above a resilience claim, not a fair-weather one).
+    chaos = artifact["chaos"]
+    assert chaos and chaos["enabled"]
+    assert chaos["injections"] >= 1, "chaos armed but nothing injected"
+    assert chaos["counts"].get("engine_hang", 0) >= 1
