@@ -1342,6 +1342,347 @@ def run_chaos_recovery(args) -> dict:
     }
 
 
+def _failover_cfg(bootstrap: str, cache_dir: str):
+    """Shared topology config for --controller-failover: built identically
+    by the child controller (submit) and the parent (expectations), so the
+    journaled recipe the reattach adopts is the one the parent reasons
+    about. offsets.policy='resume' + a pinned group: a worker restarted by
+    the rolling phase resumes its partitions from committed offsets
+    instead of re-reading ('earliest') or dropping backlog ('latest')."""
+    from storm_tpu.config import Config
+
+    cfg = Config()
+    cfg.broker.kind = "kafka"
+    cfg.broker.bootstrap = bootstrap
+    cfg.broker.input_topic = "failover-in"
+    cfg.broker.output_topic = "failover-out"
+    cfg.broker.dead_letter_topic = "failover-dlq"
+    cfg.model.name = "lenet5"
+    cfg.model.dtype = "float32"
+    cfg.model.input_shape = (28, 28, 1)
+    # Restarted workers reload compiled executables from this shared
+    # cache instead of re-tracing — the ops posture the rolling-restart
+    # goodput floor assumes (cold compiles would park a worker for most
+    # of a window).
+    cfg.model.compile_cache_dir = cache_dir
+    cfg.offsets.policy = "resume"
+    cfg.offsets.group_id = "failover-group"
+    cfg.offsets.max_behind = None
+    cfg.batch.max_batch = 64
+    cfg.batch.max_wait_ms = 5
+    cfg.batch.buckets = (64,)
+    cfg.topology.spout_parallelism = 1
+    cfg.topology.inference_parallelism = 2
+    cfg.topology.sink_parallelism = 1
+    # Fast ledger timeout: trees stranded by a worker restart replay in
+    # seconds, keeping the catch-up inside the same goodput window.
+    cfg.topology.message_timeout_s = 6.0
+    cfg.topology.max_spout_pending = 256
+    cfg.tracing.sample_rate = 0.0
+    cfg.topology.wire_format = "binary"
+    cfg.topology.spout_scheme = "raw"
+    return cfg
+
+
+_FAILOVER_PLACEMENT = {"kafka-spout": 0, "inference-bolt": 1,
+                       "kafka-bolt": 2, "dlq-bolt": 2}
+
+
+def run_failover_ctl(spec_path: str) -> int:
+    """Hidden child mode for --controller-failover: the FIRST controller.
+
+    Builds the 3-worker mesh with the journal armed, submits, prints one
+    ready line (peers + worker pids) and then just waits — the parent
+    SIGKILLs this process mid-stream, which is the whole point: this
+    controller never gets to clean up, and the mesh it orphans plus the
+    journal it wrote are all the next controller has."""
+    import signal as _signal
+
+    from storm_tpu.dist import DistCluster
+
+    with open(spec_path) as f:
+        spec = json.load(f)
+    cfg = _failover_cfg(spec["bootstrap"], spec["cache_dir"])
+    cluster = DistCluster(
+        3, env={"JAX_PLATFORMS": "cpu", "STORM_TPU_PLATFORM": "cpu"},
+        journal_dir=spec["journal_dir"], reattach=False)
+    cluster.submit("failover", cfg, dict(_FAILOVER_PLACEMENT),
+                   builder="standard")
+    print(json.dumps({"ready": True, "peers": cluster.peers,
+                      "pids": cluster._pids}), flush=True)
+    while True:
+        _signal.pause()
+
+
+def run_controller_failover(args) -> dict:
+    """``--controller-failover``: the durable-control-plane evidence run.
+
+    A CHILD process plays the first controller: 3-worker CPU mesh (spout,
+    inference, sink on separate workers), journal armed, paced offered
+    load. The parent SIGKILLs the child mid-stream (controller hard
+    death: no drain, no goodbyes), shows the orphaned mesh keeps serving,
+    then constructs a second controller on the same journal dir and
+    measures the reattach: all three survivors adopted, ZERO engine
+    recompiles (worker pids unchanged, per-worker submit counts still 1 —
+    engines only (re)build on submit/swap). Then the reattached
+    controller rolls the whole mesh (drain -> restart -> rewire, one
+    worker at a time) under load, with 10 s goodput windows gated at
+    >= 50% of the baseline median at every point.
+
+    Exactly-once lives in phase 2 (reference parity: the dist mesh is
+    at-least-once): the committed soak harness under ``--drain-drill``
+    runs the same drain cycle against the transactional path and its
+    per-record sha256 read_committed audit."""
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+    from storm_tpu.dist import DistCluster
+    from tests.kafka_stub import KafkaStubBroker
+
+    rate = 20.0          # offered msg/s: ~10x under lenet5 mesh capacity
+    stub = KafkaStubBroker(partitions=2)
+    work_dir = tempfile.mkdtemp(prefix="bench-failover-")
+    journal_dir = os.path.join(work_dir, "journal")
+    cache_dir = os.path.join(work_dir, "compile-cache")
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    cfg = _failover_cfg(f"127.0.0.1:{stub.port}", cache_dir)
+    out_topic = cfg.broker.output_topic
+    spec_path = os.path.join(work_dir, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump({"bootstrap": cfg.broker.bootstrap,
+                   "journal_dir": journal_dir,
+                   "cache_dir": cache_dir}, f)
+
+    rng = np.random.RandomState(0)
+    payloads = [
+        json.dumps({"instances": rng.rand(1, 28, 28, 1).round(4).tolist()})
+        for _ in range(16)
+    ]
+    producer = KafkaWireBroker(cfg.broker.bootstrap)
+    stop_feed = threading.Event()
+    fed = [0]
+
+    def feeder() -> None:
+        period = 1.0 / rate
+        nxt = time.perf_counter()
+        while not stop_feed.is_set():
+            try:
+                producer.produce(cfg.broker.input_topic,
+                                 payloads[fed[0] % len(payloads)])
+            except Exception:
+                time.sleep(0.5)  # stub hiccup: keep offering
+                continue
+            fed[0] += 1
+            nxt += period
+            time.sleep(max(0.0, nxt - time.perf_counter()))
+
+    timeline: list = []
+    state = {"n": 0, "t": 0.0, "t0": 0.0}
+
+    def sample(phase: str, secs: float = 1.0) -> float:
+        """Sleep ``secs`` past the last mark, append + return the
+        window's goodput off the output topic."""
+        time.sleep(max(0.0, state["t"] + secs - time.perf_counter()))
+        now = time.perf_counter()
+        n = stub.topic_size(out_topic)
+        gp = (n - state["n"]) / (now - state["t"])
+        timeline.append({"t": round(now - state["t0"], 1), "phase": phase,
+                         "goodput_msgs_s": round(gp, 2)})
+        state["n"], state["t"] = n, now
+        return gp
+
+    ctl_err = open(os.path.join(work_dir, "ctl.err"), "wb")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", STORM_TPU_PLATFORM="cpu")
+    ctl = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--_failover-ctl", spec_path],
+        stdout=subprocess.PIPE, stderr=ctl_err, cwd=repo, env=env)
+    cluster2 = None
+    try:
+        log("controller-failover: child controller building the mesh")
+        line = ctl.stdout.readline().decode()
+        if not line.strip():
+            with open(os.path.join(work_dir, "ctl.err"), "rb") as f:
+                tail = f.read()[-4000:].decode("utf-8", "replace")
+            raise RuntimeError(
+                f"failover child died during startup; stderr tail:\n{tail}")
+        ready = json.loads(line)
+        child_pids = {int(k): int(v) for k, v in ready["pids"].items()}
+        log(f"controller-failover: mesh up, worker pids {child_pids}")
+
+        feeder_thread = threading.Thread(target=feeder, daemon=True)
+        feeder_thread.start()
+        deadline = time.time() + 180
+        while stub.topic_size(out_topic) < 3 * rate:
+            if time.time() > deadline:
+                raise RuntimeError("no steady output within 180s")
+            time.sleep(0.25)
+        state["n"] = stub.topic_size(out_topic)
+        state["t"] = state["t0"] = time.perf_counter()
+
+        base_w = [sample("baseline") for _ in range(8)]
+        baseline = sorted(base_w)[len(base_w) // 2]
+        log(f"controller-failover: baseline {baseline:.1f} msg/s")
+
+        log("controller-failover: SIGKILL the controller process")
+        ctl.kill()
+        ctl.wait(timeout=10)
+        # The orphaned mesh must keep serving: the data plane does not
+        # route through the controller.
+        down_w = [sample("ctl_down") for _ in range(4)]
+
+        t0 = time.perf_counter()
+        cluster2 = DistCluster(
+            3, env={"JAX_PLATFORMS": "cpu", "STORM_TPU_PLATFORM": "cpu"},
+            journal_dir=journal_dir, reattach=True)
+        reattach_s = round(time.perf_counter() - t0, 2)
+        if not cluster2.reattached:
+            raise RuntimeError("controller failed to reattach (cold rebuild)")
+        reattach_ev = next(
+            (ev for ev in cluster2.flight.tail(50)
+             if ev.get("kind") == "dist_reattached"), {})
+        reports = cluster2.state_reports()
+        pids_after = {i: r.get("pid") for i, r in reports.items()}
+        submits_after = {i: r.get("submits") for i, r in reports.items()}
+        zero_recompile = (pids_after == child_pids
+                          and all(s == 1 for s in submits_after.values()))
+        log(f"controller-failover: reattached in {reattach_s:.2f}s "
+            f"(pids {pids_after}, submits {submits_after})")
+        cluster2.start_monitor(interval_s=0.5, misses=2)
+        post_w = [sample("reattached") for _ in range(4)]
+
+        log("controller-failover: rolling restart under load")
+        roll: dict = {}
+
+        def do_roll() -> None:
+            # settle_s=10 between workers: with one pipeline stage per
+            # worker, back-to-back restarts would keep SOME stage dark
+            # for the whole roll; the settle lets the replay backlog
+            # clear before the next stage goes down (the ops posture
+            # the runbook prescribes).
+            t = time.perf_counter()
+            try:
+                roll["rows"] = cluster2.rolling_restart(
+                    drain_timeout_s=20.0, settle_s=10.0)
+            except Exception as e:  # surfaced after the sampling loop
+                roll["error"] = repr(e)
+            finally:
+                roll["s"] = round(time.perf_counter() - t, 2)
+
+        roll_thread = threading.Thread(target=do_roll, daemon=True)
+        roll_thread.start()
+        roll_w = []
+        while roll_thread.is_alive():
+            roll_w.append(sample("rolling", secs=10.0))
+        roll_thread.join()
+        if "error" in roll:
+            raise RuntimeError(f"rolling restart failed: {roll['error']}")
+        roll_w.append(sample("rolling_settle", secs=10.0))  # final catch-up
+        roll_s = roll["s"]
+        floor = min(roll_w)
+        log(f"controller-failover: rolled 3 workers in {roll_s:.1f}s, "
+            f"goodput floor {floor:.1f} msg/s (baseline {baseline:.1f})")
+
+        reports2 = cluster2.state_reports()
+        rolled_pids = {i: r.get("pid") for i, r in reports2.items()}
+        jstats = cluster2.journal_stats()
+        stop_feed.set()
+        feeder_thread.join(timeout=10)
+        drained = cluster2.drain(timeout_s=120)
+        interesting = ("dist_reattached", "dist_worker_draining",
+                       "dist_worker_restarted", "dist_worker_recovered",
+                       "dist_heartbeat_miss")
+        ctrl_flight = [ev for ev in cluster2.flight.tail(200)
+                       if ev.get("kind") in interesting]
+    finally:
+        try:
+            if cluster2 is not None:
+                cluster2.shutdown()
+            if ctl.poll() is None:
+                ctl.kill()
+        finally:
+            ctl_err.close()
+            stub.close()
+            shutil.rmtree(work_dir, ignore_errors=True)
+
+    # Phase 2: the same drain cycle against the exactly-once transactional
+    # path (soak --drain-drill gates itself: nonzero exit on any audit
+    # violation).
+    log("controller-failover: phase 2 (soak --drain-drill, "
+        "exactly-once audit)")
+    soak = subprocess.run(
+        [sys.executable, "soak_harness.py",
+         "--seconds", "45", "--rate", "20", "--out", "-", "--drain-drill"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=390)
+    if soak.returncode != 0:
+        raise RuntimeError(
+            f"soak --drain-drill failed its exactly_once gate:\n"
+            f"{soak.stderr[-4000:]}")
+    soak_art = json.loads(soak.stdout)
+
+    floor_ratio = round(floor / baseline, 3)
+    return {
+        "metric": "controller_failover_dist3_cpu",
+        "unit": ("seconds from new-controller construction to adoption of "
+                 "all journaled survivors (reattach_s); goodput msg/s in "
+                 "windows on the output topic under a paced offered load"),
+        "value": reattach_s,
+        "offered_rate_msgs_s": rate,
+        "baseline_goodput_msgs_s": round(baseline, 2),
+        "reattach": {
+            "reattach_s": reattach_s,
+            "survivors": reattach_ev.get("survivors"),
+            "dead": reattach_ev.get("dead"),
+            "replayed_records": reattach_ev.get("replayed"),
+            "reconciled": reattach_ev.get("reconciled"),
+            "worker_pids_before": child_pids,
+            "worker_pids_after": pids_after,
+            "submits_per_worker": submits_after,
+            "zero_recompile": zero_recompile,
+        },
+        "controller_down": {
+            "windows": [round(g, 2) for g in down_w],
+            "goodput_floor_msgs_s": round(min(down_w), 2),
+            "served_without_controller": min(down_w) > 0,
+        },
+        "post_reattach_windows": [round(g, 2) for g in post_w],
+        "rolling_restart": {
+            "workers": roll.get("rows"),
+            "total_s": roll_s,
+            "window_s": 10.0,
+            "windows": [round(g, 2) for g in roll_w],
+            "goodput_floor_msgs_s": round(floor, 2),
+            "floor_ratio": floor_ratio,
+            "floor_met": floor_ratio >= 0.5,
+            "worker_pids_after_roll": rolled_pids,
+        },
+        "journal": jstats,
+        "flight": {"controller": ctrl_flight[-40:]},
+        "timeline": timeline,
+        "drained": drained,
+        "produced": fed[0],
+        "exactly_once": {
+            "where": ("in-process transactional path (soak harness "
+                      "--drain-drill): two deactivate -> flush -> activate "
+                      "cycles mid-soak, offsets+outputs committed in one "
+                      "broker txn per tree; the dist mesh above is "
+                      "at-least-once by design, reference parity"),
+            "exactly_once": soak_art["exactly_once"],
+            "audit": soak_art["audit"],
+            "events": soak_art["events"],
+        },
+        "workers": 3,
+        "chips": 0,
+        "config": "controller-failover",
+        "capture_session": _new_capture_session(),
+        "code_version": _code_version(),
+    }
+
+
 def run_cascade_compare(args) -> dict:
     """``--cascade-compare``: flagship-only (resnet20) vs the
     confidence-gated cascade (vit_tiny -> lenet5_rgb -> resnet20) on the
@@ -4000,6 +4341,16 @@ def main() -> None:
                          "3-worker CPU mesh with measured time-to-recover "
                          "and bounded replays, plus the exactly-once soak "
                          "under engine-hang chaos")
+    ap.add_argument("--controller-failover", action="store_true",
+                    help="durable control plane evidence run "
+                         "(BENCH_FAILOVER): SIGKILL the controller of a "
+                         "3-worker CPU mesh mid-stream, reattach a new one "
+                         "from the journal with zero survivor recompiles, "
+                         "then rolling-restart every worker under load with "
+                         "a goodput floor, plus the exactly-once soak under "
+                         "--drain-drill")
+    ap.add_argument("--_failover-ctl", dest="failover_ctl", default="",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--wire-compare", action="store_true",
                     help="A/B the JSON vs binary inter-worker tuple wire "
                          "on a 3-worker CPU mesh (NullEngine framework "
@@ -4055,6 +4406,11 @@ def main() -> None:
                          "The multi/autoscale/latency-breakdown demo rows "
                          "stay single-capture")
     args = ap.parse_args()
+    if args.failover_ctl:
+        sys.exit(run_failover_ctl(args.failover_ctl))
+    if args.controller_failover:
+        print(json.dumps(run_controller_failover(args)))
+        return
     if args.plan:
         print(json.dumps(run_plan(args)))
         return

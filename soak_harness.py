@@ -90,6 +90,11 @@ def main() -> int:
                          "injections under a live watchdog "
                          "(batch.watchdog_ms) driving a quarantine + "
                          "engine replacement mid-soak")
+    ap.add_argument("--drain-drill", action="store_true",
+                    help="add two graceful-drain cycles mid-soak "
+                         "(deactivate -> flush inflight -> activate), the "
+                         "per-worker step of a rolling restart, proving "
+                         "intake pause + resume preserves exactly-once")
     args = ap.parse_args()
 
     plat = os.environ.get("STORM_TPU_PLATFORM")
@@ -158,6 +163,13 @@ def main() -> int:
                             watchdog_trips=2)
     run_cfg = Config()
     run_cfg.topology.message_timeout_s = 120.0
+    if args.drain_drill:
+        # A drain cycle lands ~2s after a chaos executor kill, and a tree
+        # stranded by that kill stays in the ledger for the FULL message
+        # timeout — 120s would wedge every drain. 15s bounds the stall
+        # (legit trees settle in <1s even through the device tunnel)
+        # without changing the replay mechanism under audit.
+        run_cfg.topology.message_timeout_s = 15.0
 
     broker = wire()
     tb = TopologyBuilder()
@@ -259,6 +271,22 @@ def main() -> int:
                 inj.configure(engine_hang_ms=2500.0, engine_hang_next=2)
 
             plan.insert(4, (0.48, "chaos_engine_hang", arm_engine_hang))
+        if args.drain_drill:
+            # The per-worker step of a rolling restart, run against the
+            # live runtime: stop intake, flush every in-flight tree, then
+            # resume. Two cycles — one on each side of the rebalance/swap
+            # block — so the audit proves a drain preserves exactly-once
+            # both on the original mesh shape and on the reshaped one.
+            def drain_cycle():
+                cluster._run(rt.deactivate())
+                flushed = cluster._run(rt.drain(timeout_s=60.0))
+                cluster._run(rt.activate())
+                if not flushed:
+                    raise RuntimeError("drain did not flush within 60s")
+
+            drill = [(0.35, "drain_drill_1", drain_cycle),
+                     (0.65, "drain_drill_2", drain_cycle)]
+            plan = sorted(plan + drill, key=lambda e: e[0])
         next_plan = 0
         window_s = 10.0
         next_window = time.perf_counter() + window_s

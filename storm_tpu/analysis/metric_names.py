@@ -36,10 +36,14 @@ METRIC_NAMES = frozenset({
     'dispatch_wait_ms',
     'dist_circuit_opens',
     'dist_heartbeat_miss',
+    'dist_journal_appends',
+    'dist_journal_replayed',
+    'dist_journal_snapshots',
     'dist_parked_batches',
     'dist_replay_throttle_ms',
     'dist_replay_throttled',
     'dist_rerouted',
+    'dist_ring_remapped',
     'dist_send_failures',
     'dist_send_retries',
     'dist_wire_errors',
@@ -73,6 +77,7 @@ METRIC_NAMES = frozenset({
     'txn_commits',
     'txn_offsets_deferred',
     'watchdog_trips',
+    'worker_draining',
 })
 
 METRIC_PATTERNS = (
@@ -113,10 +118,14 @@ METRIC_KINDS = {
     'dispatch_wait_ms': ('histogram',),
     'dist_circuit_opens': ('counter',),
     'dist_heartbeat_miss': ('counter',),
+    'dist_journal_appends': ('counter',),
+    'dist_journal_replayed': ('counter',),
+    'dist_journal_snapshots': ('counter',),
     'dist_parked_batches': ('counter',),
     'dist_replay_throttle_ms': ('histogram',),
     'dist_replay_throttled': ('counter',),
     'dist_rerouted': ('counter',),
+    'dist_ring_remapped': ('counter',),
     'dist_send_failures': ('counter',),
     'dist_send_retries': ('counter',),
     'dist_wire_errors': ('counter',),
@@ -150,6 +159,7 @@ METRIC_KINDS = {
     'txn_commits': ('counter',),
     'txn_offsets_deferred': ('counter',),
     'watchdog_trips': ('counter',),
+    'worker_draining': ('gauge',),
 }
 
 

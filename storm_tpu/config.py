@@ -462,6 +462,22 @@ class ControlConfig:
     exports the resolved token to its spawned workers via the same var."""
 
     auth_token: str = ""
+    #: Directory for the controller's write-ahead journal ("" = no
+    #: journal: a controller crash forgets the mesh and a restart
+    #: rebuilds every worker from scratch). With a journal dir, a
+    #: restarted controller replays the log and REATTACHES to live
+    #: workers — warm engines stay warm.
+    journal_dir: str = ""
+    #: Compact (snapshot + truncate) the journal after this many
+    #: appends since the last snapshot.
+    journal_snapshot_every: int = 64
+    #: Whether a journal-backed controller attempts reattach on start
+    #: (False = always cold-rebuild, e.g. after deliberate mesh wipe).
+    reattach: bool = True
+
+    def __post_init__(self) -> None:
+        if int(self.journal_snapshot_every) < 1:
+            raise ValueError("control.journal_snapshot_every must be >= 1")
 
     def resolve_token(self) -> str:
         import os
@@ -813,6 +829,11 @@ class ChaosConfig:
     # seconds under ``dist`` runs (0 = off). Recovery comes from the
     # heartbeat monitor; the kill itself is logged by the controller.
     kill_worker_s: float = 0.0
+    # Daemon-driven controller chaos: this many seconds into a dist run
+    # the daemon abandons its controller (drops every handle, workers
+    # keep serving) and builds a fresh one from the journal to prove
+    # reattach (0 = off; requires control.journal_dir).
+    kill_controller_s: float = 0.0
 
     def __post_init__(self) -> None:
         for name in ("wire_drop_pct", "corrupt_pct"):
@@ -821,7 +842,7 @@ class ChaosConfig:
                 raise ValueError(
                     f"chaos.{name} must be in [0, 1], got {v!r}")
         for name in ("wire_latency_ms", "wire_jitter_ms", "engine_hang_ms",
-                     "kill_worker_s"):
+                     "kill_worker_s", "kill_controller_s"):
             if float(getattr(self, name)) < 0:
                 raise ValueError(
                     f"chaos.{name} must be >= 0, got "

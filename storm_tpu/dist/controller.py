@@ -21,13 +21,16 @@ from __future__ import annotations
 
 import json
 import logging
+import os
+import signal
 import subprocess
 import sys
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set
 
 from storm_tpu.config import Config
+from storm_tpu.dist.journal import ControllerJournal, ControlPlaneState
 from storm_tpu.dist.transport import WorkerClient
 
 log = logging.getLogger("storm_tpu.dist.controller")
@@ -98,6 +101,9 @@ class DistCluster:
         env: Optional[dict] = None,
         worker_resources: Optional[dict] = None,
         auth_token: Optional[str] = None,
+        journal_dir: Optional[str] = None,
+        reattach: bool = True,
+        journal_snapshot_every: int = 64,
     ) -> None:
         """Spawn ``n_workers`` local worker processes, or attach to
         ``addrs`` (["host:port", ...]) if given. ``worker_resources``
@@ -106,7 +112,17 @@ class DistCluster:
         (default: $STORM_TPU_CONTROL_TOKEN) is the shared control-plane
         secret: exported to spawned workers and attached to every RPC;
         workers reject token-less/mismatched calls (config
-        ``control.auth_token``)."""
+        ``control.auth_token``).
+
+        ``journal_dir`` arms the control-plane WAL
+        (:mod:`storm_tpu.dist.journal`): every transition is journaled
+        before its RPCs, and a NEW controller started on the same dir
+        (with ``reattach=True``, the default) replays the log, probes
+        the advertised workers, and adopts the live survivors instead of
+        rebuilding the mesh — warm engines stay warm. Unreachable
+        workers are replaced via :meth:`recover_worker`; when no worker
+        answers, the controller falls back to a cold spawn and resets
+        the journal. ``self.reattached`` records which path ran."""
         from storm_tpu.dist.transport import TOKEN_ENV, _env_token
 
         self._token = _env_token() if auth_token is None else auth_token
@@ -137,6 +153,35 @@ class DistCluster:
         self.flight = FlightRecorder()
         self._hb_miss = self.ctrl_metrics.counter(
             "controller", "dist_heartbeat_miss")
+        self._journal_appends = self.ctrl_metrics.counter(
+            "controller", "dist_journal_appends")
+        self._journal_snapshots = self.ctrl_metrics.counter(
+            "controller", "dist_journal_snapshots")
+        self._journal_replayed = self.ctrl_metrics.counter(
+            "controller", "dist_journal_replayed")
+        # Workers the controller itself is draining: the heartbeat
+        # monitor must not declare these dead (satellite: rolling
+        # restarts must not race recover_worker).
+        self._draining: Set[int] = set()
+        self._pids: Dict[int, int] = {}
+        self._placement: Dict[str, int] = {}
+        self.peers: Dict[int, str] = {}
+        self.reattached = False
+        self._journal: Optional[ControllerJournal] = None
+        if journal_dir:
+            self._journal = ControllerJournal(
+                journal_dir, snapshot_every=journal_snapshot_every)
+            st = self._journal.load()
+            if st.replayed:
+                self._journal_replayed.inc(st.replayed)
+            if reattach and not addrs and st.peers:
+                self.reattached = self._try_reattach(st)
+                if self.reattached:
+                    return  # mesh adopted; nothing to spawn
+                # Cold rebuild: the journaled mesh is gone. Reset the
+                # fold so the stale recipe can't resurrect on the NEXT
+                # restart against a fresh mesh it was never shipped to.
+                self._jappend("kill")
         if addrs:
             for addr in addrs:
                 self.clients.append(WorkerClient(addr, token=self._token))
@@ -145,10 +190,11 @@ class DistCluster:
                 proc, client = self._spawn_worker(i)
                 self.procs.append(proc)
                 self.clients.append(client)
+                self._pids[i] = proc.pid
         for c in self.clients:
             c.wait_ready()
         self.peers = {i: c.target for i, c in enumerate(self.clients)}
-        self._placement: Dict[str, int] = {}
+        self._jappend("workers", peers=self.peers, pids=self._pids)
 
     def _spawn_worker(self, index: int):
         import os
@@ -185,6 +231,154 @@ class DistCluster:
         info = json.loads(line)
         return proc, WorkerClient(f"127.0.0.1:{info['port']}",
                                   token=self._token)
+
+    # ---- control-plane durability (dist/journal.py) --------------------------
+
+    def _jappend(self, kind: str, **data: Any) -> None:
+        """Journal one transition (write-ahead: callers append BEFORE the
+        RPCs that apply it, so the journal is only ever ahead of the
+        mesh). Journal IO errors propagate — a control plane that can't
+        make its state durable must fail the transition, not ack it."""
+        j = self._journal
+        if j is None:
+            return
+        j.append(kind, **data)
+        self._journal_appends.inc()
+        if j.maybe_snapshot():
+            self._journal_snapshots.inc()
+
+    def journal_stats(self) -> Optional[Dict[str, int]]:
+        return self._journal.stats() if self._journal is not None else None
+
+    def state_reports(self, timeout: float = 5.0) -> Dict[int, dict]:
+        """Each worker's self-description (pid, submit count, live
+        parallelisms) — the reconciliation input, also useful evidence
+        that survivors kept their processes and engines."""
+        return {i: c.control("state_report", timeout=timeout)
+                for i, c in enumerate(self.clients)}
+
+    @staticmethod
+    def reconcile_parallelism(
+        rebalances: Dict[str, int],
+        placement: Dict[str, int],
+        reports: Dict[int, dict],
+    ) -> Dict[str, int]:
+        """Components whose journaled parallelism disagrees with the
+        hosting worker's actual. Write-ahead ordering means the journal
+        records intent, so the journaled value wins and the controller
+        re-issues the rebalance; a worker can only ever be BEHIND the
+        journal (an RPC that never ran), never ahead of it."""
+        out: Dict[str, int] = {}
+        for component, par in rebalances.items():
+            rep = reports.get(placement.get(component)) or {}
+            actual = (rep.get("parallelism") or {}).get(component)
+            if actual is not None and int(actual) != int(par):
+                out[component] = int(par)
+        return out
+
+    def _try_reattach(self, st: ControlPlaneState) -> bool:
+        """Adopt the journaled mesh: probe every advertised worker, keep
+        the live ones exactly as they are (no re-submit — warm engines
+        stay warm), reconcile their actual state against the journal,
+        and replace the dead ones. Returns False (caller cold-rebuilds)
+        when NO worker answers."""
+        t0 = time.monotonic()
+        reports: Dict[int, dict] = {}
+        clients: Dict[int, WorkerClient] = {}
+        for idx in sorted(st.peers):
+            c = WorkerClient(st.peers[idx], token=self._token)
+            clients[idx] = c
+            try:
+                rep = c.probe("state_report", timeout=3.0)
+                if not rep.get("ok"):
+                    raise RuntimeError(rep.get("error", "state_report failed"))
+                reports[idx] = rep
+            except Exception as e:
+                log.warning("reattach: worker %d at %s unreachable (%s)",
+                            idx, st.peers[idx], e)
+        if not reports:
+            for c in clients.values():
+                c.close()
+            log.warning("reattach: no survivors among %d journaled workers; "
+                        "cold rebuild", len(st.peers))
+            return False
+        n = max(st.peers) + 1
+        self.clients = [clients[i] for i in range(n)]
+        self.procs = [None] * n  # survivors are adopted, not owned
+        self.peers = dict(st.peers)
+        self._pids = dict(st.pids)
+        self._placement = dict(st.placement)
+        self._recipe = dict(st.recipe) if st.recipe else None
+        self._rebalances = dict(st.rebalances)
+        self._swaps = {k: dict(v) for k, v in st.swaps.items()}
+        self._activated = st.activated
+        # Reconcile: journal intent wins. Re-issue rebalances whose RPCs
+        # never landed (host first when growing, peers first when
+        # shrinking — same ordering as rebalance()).
+        fixes = self.reconcile_parallelism(
+            self._rebalances, self._placement, reports)
+        for component, par in fixes.items():
+            w = self._placement[component]
+            current = int(reports[w]["parallelism"][component])
+            others = [self.clients[i] for i in sorted(reports) if i != w]
+            targets = ([self.clients[w], *others] if par >= current
+                       else [*others, self.clients[w]])
+            for c in targets:
+                c.control("rebalance", component=component, parallelism=par)
+        for idx in sorted(reports):
+            rep = reports[idx]
+            if self._recipe is not None and not rep.get("topology"):
+                # Alive but empty (e.g. crashed+restarted by an operator
+                # between controllers): ship it the full recipe.
+                self._reship(idx, self.clients[idx])
+            elif rep.get("active") is not None and \
+                    bool(rep["active"]) != self._activated:
+                self.clients[idx].control(
+                    "activate" if self._activated else "deactivate")
+        dead = [i for i in range(n) if i not in reports]
+        self.flight.event(
+            "dist_reattached", survivors=sorted(reports), dead=dead,
+            replayed=st.replayed, reconciled=sorted(fixes),
+            reattach_s=round(time.monotonic() - t0, 3))
+        log.info("reattached to %d/%d workers in %.2fs (reconciled: %s)",
+                 len(reports), n, time.monotonic() - t0, sorted(fixes) or "-")
+        for idx in dead:
+            self.recover_worker(idx)
+        return True
+
+    def _reship(self, idx: int, client: WorkerClient) -> None:
+        """Send one worker the full live recipe: submit + two-phase start
+        at the current lifecycle state, then replayed rebalances/swaps —
+        the same sequence recover_worker runs for a replacement."""
+        client.control(
+            "submit",
+            name=self._recipe["name"],
+            config=self._recipe["config"],
+            placement=self._placement,
+            peers=self.peers,
+            builder=self._recipe["builder"],
+        )
+        client.control("start_bolts")
+        if not self._activated:
+            # Executors exist after start_bolts; pausing before
+            # start_spouts means they start with _active=False and
+            # never emit.
+            client.control("deactivate")
+        client.control("start_spouts")
+        # Re-apply live rebalances AFTER start (rebalance starts the
+        # executors it adds; applying pre-start would double-start
+        # them). Until these land, deliveries to not-yet-grown tasks
+        # drop and replay — at-least-once covers the window.
+        for component, par in self._rebalances.items():
+            client.control(
+                "rebalance", component=component, parallelism=par)
+        # Re-apply live model swaps, or the worker serves the
+        # submit-time model (silent rollout rollback).
+        for component, overrides in self._swaps.items():
+            if self._placement.get(component) == idx:
+                client.control(
+                    "swap_model", component=component,
+                    model=overrides, timeout=600.0)
 
     # ---- topology lifecycle --------------------------------------------------
 
@@ -228,6 +422,8 @@ class DistCluster:
             self._activated = True  # fresh topology starts active
             self._rebalances.clear()
             self._swaps.clear()
+            self._jappend("submit", name=name, config=cfg.to_dict(),
+                          builder=builder, placement=placement)
             for c in self.clients:
                 c.control(
                     "submit",
@@ -481,6 +677,12 @@ class DistCluster:
             current = host.control("parallelism", component=component)["parallelism"]
             others = [c for i, c in enumerate(self.clients) if i != w]
             targets = [host, *others] if parallelism >= current else [*others, host]
+            # Write-ahead: journal the intent before any worker changes.
+            # If the RPC fan-out dies midway, a reattaching controller
+            # sees the journaled value disagree with the host's actual
+            # and re-issues it (reconcile_parallelism).
+            self._jappend("rebalance", component=component,
+                          parallelism=parallelism)
             for c in targets:
                 c.control("rebalance", component=component, parallelism=parallelism)
             # Recorded so a recovered worker rebuilds at the LIVE
@@ -514,9 +716,14 @@ class DistCluster:
         if tasks is None:
             # Canary swaps are deliberately NOT recorded for recovery
             # replay: a replaced worker restarts on the majority model.
+            # Journaled AFTER success (unlike rebalance): replaying a
+            # swap that never took would roll a canary-rejected model
+            # onto the whole component at reattach.
             with self._lock:
                 merged = {**self._swaps.get(component, {}), **overrides}
                 self._swaps[component] = merged
+                self._jappend("swap_model", component=component,
+                              overrides=merged)
         return resp.get("model", {})
 
     def component_stats(self, component: str) -> list:
@@ -588,6 +795,15 @@ class DistCluster:
                 for i in range(len(self.clients)):
                     with self._lock:
                         client = self.clients[i]
+                        draining = i in self._draining
+                    if draining:
+                        # A controller-initiated drain is not a death:
+                        # the worker is unresponsive ON PURPOSE (flushing,
+                        # restarting). Declaring it dead here would race
+                        # recover_worker against rolling_restart's own
+                        # respawn of the same index.
+                        fails[i] = 0
+                        continue
                     try:
                         client.control("ping", timeout=max(1.0, interval_s))
                         fails[i] = 0
@@ -655,6 +871,16 @@ class DistCluster:
             if old_proc is not None:
                 old_proc.kill()
                 old_proc.wait(timeout=10)
+            else:
+                # Adopted (reattached) worker: no Popen handle, but the
+                # journal remembers its pid — make sure a half-dead
+                # process isn't still holding resources.
+                pid = self._pids.get(idx)
+                if pid:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
             try:
                 self.clients[idx].close()
             except Exception:
@@ -664,6 +890,9 @@ class DistCluster:
             self.procs[idx] = proc
             self.clients[idx] = client
             self.peers[idx] = client.target
+            self._pids[idx] = proc.pid
+            self._jappend("peer_update", idx=idx, addr=client.target,
+                          pid=proc.pid)
             # Surviving peers aim their senders at the replacement. A peer
             # left pointing at the dead address would replay its tuples
             # forever, so retry; if a LIVE peer stays unreachable, kill the
@@ -699,43 +928,142 @@ class DistCluster:
             # lifecycle state: current parallelisms, and spouts paused if
             # the cluster is deactivated/draining.
             if self._recipe is not None:
-                client.control(
-                    "submit",
-                    name=self._recipe["name"],
-                    config=self._recipe["config"],
-                    placement=self._placement,
-                    peers=self.peers,
-                    builder=self._recipe["builder"],
-                )
-                client.control("start_bolts")
-                if not self._activated:
-                    # Executors exist after start_bolts; pausing before
-                    # start_spouts means they start with _active=False and
-                    # never emit.
-                    client.control("deactivate")
-                client.control("start_spouts")
-                # Re-apply live rebalances AFTER start (rebalance starts the
-                # executors it adds; applying pre-start would double-start
-                # them). Until these land, deliveries to not-yet-grown tasks
-                # drop and replay — at-least-once covers the window.
-                for component, par in self._rebalances.items():
-                    client.control(
-                        "rebalance", component=component, parallelism=par
-                    )
-                # Re-apply live model swaps, or the replacement serves the
-                # submit-time model (silent rollout rollback).
-                for component, overrides in self._swaps.items():
-                    if self._placement.get(component) == idx:
-                        client.control(
-                            "swap_model", component=component,
-                            model=overrides, timeout=600.0,
-                        )
+                self._reship(idx, client)
+
+    # ---- graceful drain + rolling restart ------------------------------------
+
+    def drain_worker(self, idx: int, timeout_s: float = 30.0) -> dict:
+        """Gracefully drain ONE worker: it stops intake (new deliveries
+        park on the senders' side), flushes its local inflight, writes a
+        final state checkpoint for its stateful bolts, and acks. While
+        draining, the heartbeat monitor is suppressed for this index —
+        the worker is busy on purpose; declaring it dead would race the
+        caller's own restart of the same slot. The mark clears on
+        failure, on :meth:`clear_drain`, or when :meth:`rolling_restart`
+        finishes replacing the worker."""
+        with self._lock:
+            if not 0 <= idx < len(self.clients):
+                raise KeyError(f"no worker {idx}")
+            client = self.clients[idx]
+            self._draining.add(idx)
+        self.flight.event("dist_worker_draining", worker=idx)
+        try:
+            return client.control("drain_worker", timeout_s=timeout_s,
+                                  timeout=timeout_s + 30.0)
+        except Exception:
+            with self._lock:
+                self._draining.discard(idx)
+            raise
+
+    def clear_drain(self, idx: int) -> None:
+        """Re-arm the heartbeat monitor for a worker after a drain that
+        was not followed by a restart (drill / cancelled maintenance)."""
+        with self._lock:
+            self._draining.discard(idx)
+
+    def rolling_restart(self, drain_timeout_s: float = 30.0,
+                        settle_s: float = 0.0) -> List[dict]:
+        """Restart every worker one at a time with zero tuple loss:
+        graceful drain → clean process exit → respawn + rewire + recipe
+        re-ship (via :meth:`recover_worker`). At-least-once covers the
+        per-worker blackout — the spout ledger replays trees that were
+        headed for the restarting worker — and the drain keeps that
+        replay set small (the worker's own inflight reached zero before
+        it exited). ``settle_s`` pauses between workers so the mesh
+        catches up on the replay backlog before the next stage goes
+        dark — on a placement with one pipeline stage per worker,
+        back-to-back restarts would otherwise keep SOME stage down for
+        the whole roll and goodput at zero until the last worker is
+        back. Returns one summary row per worker."""
+        results: List[dict] = []
+        last = len(self.clients) - 1
+        for idx in range(len(self.clients)):
+            t0 = time.monotonic()
+            old_pid = self._pids.get(idx)
+            drained = False
+            try:
+                try:
+                    ack = self.drain_worker(idx, timeout_s=drain_timeout_s)
+                    drained = bool(ack.get("ok"))
+                except Exception as e:
+                    log.warning("rolling restart: drain of worker %d failed"
+                                " (%s); restarting it anyway", idx, e)
+                    with self._lock:
+                        self._draining.add(idx)
+                with self._lock:
+                    client = self.clients[idx]
+                try:
+                    client.control("shutdown", timeout=5.0)
+                except Exception:
+                    pass
+                self._wait_worker_exit(idx, timeout_s=15.0)
+                self.recover_worker(idx)
+            finally:
+                self.clear_drain(idx)
+            row = {"worker": idx, "drained": drained, "old_pid": old_pid,
+                   "new_pid": self._pids.get(idx),
+                   "restart_s": round(time.monotonic() - t0, 2)}
+            results.append(row)
+            self.flight.event("dist_worker_restarted", worker=idx,
+                              drained=drained, restart_s=row["restart_s"])
+            if settle_s > 0 and idx < last:
+                time.sleep(settle_s)
+        return results
+
+    def _wait_worker_exit(self, idx: int, timeout_s: float = 15.0) -> None:
+        """Wait for a worker process to exit after a shutdown RPC — by
+        Popen handle when we spawned it, by journaled pid when adopted."""
+        with self._lock:
+            proc = self.procs[idx] if self.procs else None
+            pid = self._pids.get(idx)
+        if proc is not None:
+            try:
+                proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+            return
+        if not pid:
+            return
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return
+            time.sleep(0.1)
+        try:  # graceful exit never came; force it
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    def abandon(self) -> None:
+        """Drop the controller's handles WITHOUT touching the workers —
+        the in-process equivalent of a controller crash (a SIGKILL
+        orphans the mesh but the workers keep serving). The journal
+        keeps the control-plane state; a new ``DistCluster`` on the same
+        ``journal_dir`` reattaches to the survivors. Used by the daemon
+        chaos drill (``chaos.kill_controller_s``) and tests."""
+        self.stop_monitor()
+        with self._lock:
+            self._closing = True
+            clients, self.clients = list(self.clients), []
+            self.procs = []
+            files, self._stderr_files = list(self._stderr_files), []
+            self._stderr_by_index.clear()
+        for c in clients:
+            c.close()
+        for f in files:
+            f.close()
+        if self._journal is not None:
+            self._journal.close()
 
     # ---- teardown ------------------------------------------------------------
 
     def drain(self, timeout_s: float = 30.0) -> bool:
         with self._lock:  # serialize against a recovery in flight
             self._activated = False  # a recovery mid-drain must not re-emit
+            self._jappend("activation", activated=False)
             for c in self.clients:
                 c.control("deactivate")
             ok = True
@@ -752,6 +1080,7 @@ class DistCluster:
         spout state from ``self._activated``, which is already False."""
         with self._lock:
             self._activated = False
+            self._jappend("activation", activated=False)
             clients = list(self.clients)
         for c in clients:
             c.control("deactivate")
@@ -760,6 +1089,7 @@ class DistCluster:
         """Resume spouts after a deactivate/drain (Storm's 'activate')."""
         with self._lock:
             self._activated = True
+            self._jappend("activation", activated=True)
             clients = list(self.clients)
         for c in clients:
             c.control("activate")
@@ -776,6 +1106,7 @@ class DistCluster:
             self._recipe = None
             self._rebalances.clear()
             self._swaps.clear()
+            self._jappend("kill")
             clients = list(self.clients)
         for c in clients:
             c.control("kill", wait_secs=wait_secs)
@@ -791,13 +1122,23 @@ class DistCluster:
         with self._lock:
             clients, self.clients = list(self.clients), []
             procs, self.procs = [p for p in self.procs if p is not None], []
+            pids = dict(self._pids)
             files, self._stderr_files = list(self._stderr_files), []
             self._stderr_by_index.clear()
-        for c in clients:
+        for i, c in enumerate(clients):
             try:
                 c.control("shutdown", timeout=5.0)
             except Exception:
-                pass
+                # An ADOPTED worker (reattach: no Popen handle to wait on
+                # below) that also won't take the shutdown RPC would
+                # outlive the controller; the journaled pid is the only
+                # remaining handle.
+                pid = pids.get(i)
+                if pid:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
             c.close()
         for p in procs:
             try:
@@ -806,6 +1147,8 @@ class DistCluster:
                 p.kill()
         for f in files:
             f.close()
+        if self._journal is not None:
+            self._journal.close()
 
     def __enter__(self) -> "DistCluster":
         return self

@@ -229,6 +229,19 @@ class WorkerClient:
             raise RuntimeError(f"{self.target} {cmd}: {resp['error']}")
         return resp
 
+    def probe(self, cmd: str = "ping", timeout: float = 3.0,
+              **kwargs: Any) -> Dict:
+        """One-shot control RPC with NO retry/backoff: liveness checks
+        must answer "is it there right now", and the broad retry policy
+        under :meth:`control` would stretch a dead peer into tens of
+        seconds of backoff. Used by the controller's reattach probe."""
+        req = json.dumps({"cmd": cmd, **kwargs}).encode("utf-8")
+        resp = json.loads(
+            self._control(req, timeout=timeout, metadata=self._md))
+        if resp.get("error"):
+            raise RuntimeError(f"{self.target} {cmd}: {resp['error']}")
+        return resp
+
     def wait_ready(self, timeout: float = 30.0) -> None:
         """Poll ping until the worker answers — but classify failures: a
         worker that is UP and rejecting us (bad control token ->

@@ -23,6 +23,7 @@ import asyncio
 import functools
 import json
 import logging
+import os
 import sys
 import threading
 import time
@@ -497,6 +498,14 @@ class DistRuntime(TopologyRuntime):
             self.senders,
         )
         self._reroute_rr = 0  # round-robin cursor for reroute_tuple
+        # Graceful-drain state (controller drain_worker / rolling
+        # restarts): while set, _on_deliver rejects new batches
+        # (UNAVAILABLE — senders retry/park; at-least-once covers the
+        # gap) so the local flush can actually reach empty.
+        self._draining = False
+        self._draining_gauge = self.metrics.gauge(
+            "_control", "worker_draining")
+        self._draining_gauge.set(0)
         # Arm the process-wide chaos injector from [chaos] (no-op unless
         # enabled) so submit-recipe chaos reaches every worker.
         install_chaos(getattr(config, "chaos", None), flight=self.flight)
@@ -654,6 +663,105 @@ class DistRuntime(TopologyRuntime):
             del group.inboxes[parallelism:]
         self.router.reprepare(component)
         self.topology.specs[component].parallelism = parallelism
+        self._pace_ring_handoff(component, sender)
+
+    def _pace_ring_handoff(self, component: str, sender: PeerSender) -> None:
+        """After a ring-grouped component resizes, ~1/N of its keys just
+        moved to different tasks (RingFieldsGrouping diff-updated its
+        ring in reprepare above). The moved keys' in-flight trees replay
+        onto tasks with no warm state for them — pace that bounded
+        handoff through the recovery token bucket, exactly like a
+        peer-replacement replay, and leave evidence."""
+        from storm_tpu.dist.ring import RingFieldsGrouping
+
+        spec = self.topology.specs.get(component)
+        if spec is None:
+            return
+        frac = max((sub.grouping.last_remap_fraction
+                    for sub in spec.inputs
+                    if isinstance(sub.grouping, RingFieldsGrouping)),
+                   default=0.0)
+        if frac <= 0:
+            return
+        self.metrics.counter("_transport", "dist_ring_remapped").inc()
+        sender.begin_recovery_pacing(
+            self._replay_rate(), self.config.resilience.replay_window_s)
+        if self.flight is not None:
+            self.flight.event("ring_handoff", component=component,
+                              remapped_fraction=round(frac, 4))
+
+    # ---- graceful drain (controller drain_worker / rolling restart) ----------
+
+    async def drain_for_restart(self, timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Per-worker graceful drain: stop intake -> flush inflight ->
+        final state checkpoint -> ack. Unlike :meth:`drain` (cluster-wide,
+        spouts everywhere stop first) this worker drains ALONE while its
+        peers keep producing: new Deliver batches are rejected UNAVAILABLE
+        (senders retry, then park behind their circuit — at-least-once
+        replay covers whatever parks), local spouts deactivate, and the
+        flush waits for local inboxes, outbound sender queues, and owned
+        ledger trees to reach zero. The controller suppresses heartbeat
+        death-declaration for the duration."""
+        self._draining = True
+        self._draining_gauge.set(1)
+        if self.flight is not None:
+            self.flight.event("worker_draining", worker=self.worker_idx)
+        await self.deactivate()  # local spouts only; no-op on bolt workers
+        flushed = await self._flush_for_restart(timeout_s)
+        checkpoints = self._final_checkpoints()
+        if self.flight is not None:
+            self.flight.event("worker_drained", worker=self.worker_idx,
+                              flushed=flushed, checkpoints=checkpoints)
+        return {"ok": flushed, "flushed": flushed,
+                "checkpoints": checkpoints}
+
+    async def _flush_for_restart(self, timeout_s: float) -> bool:
+        """Wait until this worker holds no work: bolt inboxes empty,
+        outbound sender queues empty, and (on spout hosts) no inflight
+        trees in the owned ledger. Bounded by ``timeout_s``."""
+
+        def busy() -> bool:
+            if self.ledger.inflight > 0:
+                return True
+            if any(e.inbox.qsize() > 0
+                   for execs in self.bolt_execs.values() for e in execs):
+                return True
+            return any(s.queue.qsize() > 0 for s in self.senders.values())
+
+        deadline = time.monotonic() + timeout_s
+        settled = 0
+        while time.monotonic() < deadline:
+            if busy():
+                settled = 0
+                await asyncio.sleep(0.02)
+                continue
+            # An executor can be mid-execute with its sends not yet
+            # queued: require two consecutive idle observations a tick
+            # apart before declaring the flush complete.
+            settled += 1
+            if settled >= 2:
+                return True
+            await asyncio.sleep(0.05)
+        return False
+
+    def _final_checkpoints(self) -> int:
+        """Final state checkpoint for every stateful bolt executor (runs
+        on the loop thread; executors are idle post-flush). Dirty-flag
+        short-circuiting inside _checkpoint keeps this cheap."""
+        n = 0
+        for execs in self.bolt_execs.values():
+            for e in execs:
+                if getattr(e, "_stateful", False):
+                    e._checkpoint()
+                    n += 1
+        return n
+
+    async def activate(self) -> None:
+        # Re-opening intake on activate lets a drained-but-kept worker
+        # return to service (drain drill / cancelled maintenance).
+        self._draining = False
+        self._draining_gauge.set(0)
+        await super().activate()
 
     async def start_bolts(self) -> None:
         self._make_executors()
@@ -755,6 +863,10 @@ class WorkerServer:
         self.index = index
         self.loop = asyncio.new_event_loop()
         self.rt: Optional[DistRuntime] = None
+        # Topology builds since process start: engines (re)compile only
+        # on submit/swap, so a reattaching controller reads this to
+        # prove survivors kept their warm engines (state_report).
+        self._submits = 0
         self._broker = None
         self._profile_thread: Optional[threading.Thread] = None
         self._profile_lock = threading.Lock()
@@ -774,6 +886,13 @@ class WorkerServer:
         rt = self.rt  # snapshot: a concurrent 'kill' may null the attribute
         if rt is None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no topology")
+        if rt._draining:
+            # Stop intake (graceful drain): UNAVAILABLE is the one code
+            # Deliver senders retry — they back off, circuit-open, and
+            # park; the ledger replays whatever is still parked when the
+            # replacement worker comes up. Acks stay accepted (the flush
+            # needs them to complete inflight trees).
+            context.abort(grpc.StatusCode.UNAVAILABLE, "worker draining")
         # W3C traceparent metadata (PeerSender attaches the batch's first
         # sampled context): adopting it stamps the trace's arrival on this
         # worker before any executor span, so cross-host transit shows up
@@ -815,6 +934,27 @@ class WorkerServer:
             # PeerSender._negotiate).
             return {"ok": True, "index": self.index,
                     "wire": wire.WIRE_VERSION}
+        if cmd == "state_report":
+            # Self-description for controller reattach/reconciliation:
+            # works pre-submit (a restarted-by-operator empty worker must
+            # still be adoptable). ``submits`` staying at 1 across a
+            # controller restart is the zero-recompile evidence.
+            rep: Dict[str, Any] = {
+                "ok": True, "index": self.index, "pid": os.getpid(),
+                "submits": self._submits, "wire": wire.WIRE_VERSION,
+            }
+            rt = self.rt
+            if rt is not None:
+                rep["topology"] = rt.name
+                rep["draining"] = bool(rt._draining)
+                rep["parallelism"] = {
+                    cid: rt.parallelism_of(cid)
+                    for cid in rt.topology.specs}
+                if rt.spout_execs:
+                    rep["active"] = any(
+                        e._active for execs in rt.spout_execs.values()
+                        for e in execs)
+            return rep
         if cmd == "submit":
             cfg = Config.from_dict(req["config"])
             from storm_tpu.main import _make_broker
@@ -827,6 +967,7 @@ class WorkerServer:
                 {k: int(v) for k, v in req["placement"].items()},
                 {int(k): v for k, v in req["peers"].items()},
             )
+            self._submits += 1
             return {"ok": True}
         if cmd == "chaos":
             # Live fault injection (bench/chaos drills): set any subset of
@@ -947,6 +1088,10 @@ class WorkerServer:
                 self.rt.drain(timeout_s=req.get("timeout_s", 30.0))
             )
             return {"ok": bool(ok)}
+        if cmd == "drain_worker":
+            t = float(req.get("timeout_s", 30.0))
+            return self._run_on_loop(
+                self.rt.drain_for_restart(timeout_s=t), timeout=t + 60.0)
         if cmd == "kill":
             self._run_on_loop(self.rt.kill(req.get("wait_secs", 0.0)))
             self.rt = None
@@ -989,8 +1134,6 @@ def main(argv=None) -> int:
     # register regardless of JAX_PLATFORMS; STORM_TPU_PLATFORM pins the
     # backend hard via jax.config, which the plugin cannot override. Tests
     # set it to "cpu" so worker processes never contend for the one TPU.
-    import os
-
     plat = os.environ.get("STORM_TPU_PLATFORM")
     if plat:
         import jax

@@ -895,6 +895,11 @@ def main(argv=None) -> int:
     distp.add_argument("--ui-port", type=int, default=-1,
                        help="serve the Storm-UI HTTP API over the dist "
                             "controller (0 = ephemeral, -1 = off)")
+    distp.add_argument("--journal-dir", default="",
+                       help="controller write-ahead journal directory "
+                            "(overrides control.journal_dir): a restarted "
+                            "controller replays it and reattaches to live "
+                            "workers instead of rebuilding them")
 
     servep = sub.add_parser("serve", help="run the gRPC TPU inference worker")
     servep.add_argument("--config", help="TOML/JSON config file")
@@ -1133,13 +1138,30 @@ def main(argv=None) -> int:
         # UI must never stay open in a posture where the workers think
         # the cluster is locked (review r5).
         control_token = cfg.control.resolve_token()
-        with DistCluster(
+        if args.journal_dir:
+            cfg.control.journal_dir = args.journal_dir
+        # The drill thread below may REPLACE the controller mid-run
+        # (abandon + journal reattach), so everything after this point
+        # reads the live handle through `holder` instead of a binding
+        # frozen at construction time.
+        cluster = DistCluster(
             n_workers=args.workers, addrs=args.attach or None,
             auth_token=control_token,
-        ) as cluster:
-            placement = cluster.submit(args.name, cfg, builder=builder)
-            print(f"topology {args.name!r} across {len(cluster.clients)} "
-                  f"workers: {placement}", file=sys.stderr)
+            journal_dir=cfg.control.journal_dir or None,
+            reattach=cfg.control.reattach,
+            journal_snapshot_every=cfg.control.journal_snapshot_every,
+        )
+        holder = {"cluster": cluster}
+        try:
+            if cluster.reattached:
+                running = (cluster._recipe or {}).get("name", args.name)
+                print(f"controller reattached to {len(cluster.clients)} "
+                      f"workers from journal {cfg.control.journal_dir!r}; "
+                      f"topology {running!r} kept running", file=sys.stderr)
+            else:
+                placement = cluster.submit(args.name, cfg, builder=builder)
+                print(f"topology {args.name!r} across {len(cluster.clients)} "
+                      f"workers: {placement}", file=sys.stderr)
             ui = ui_loop = None
             if args.ui_port >= 0:
                 # The dist controller is synchronous; the UI server runs on
@@ -1172,21 +1194,73 @@ def main(argv=None) -> int:
 
                 def kill_loop() -> None:
                     while not stop_chaos.wait(cfg.chaos.kill_worker_s):
-                        live = [i for i, p in enumerate(cluster.procs)
+                        c = holder["cluster"]
+                        live = [i for i, p in enumerate(c.procs)
                                 if p is not None and p.poll() is None]
                         if len(live) < 2:
                             continue  # never kill the last worker standing
                         victim = rng.choice(live[1:])  # spare the spout host
                         print(f"chaos: SIGKILL worker {victim}",
                               file=sys.stderr)
-                        cluster.flight.event("chaos_injection",
-                                             target="worker_kill",
-                                             worker=victim)
-                        cluster.procs[victim].kill()
+                        c.flight.event("chaos_injection",
+                                       target="worker_kill",
+                                       worker=victim)
+                        c.procs[victim].kill()
 
                 chaos_thread = threading.Thread(
                     target=kill_loop, name="chaos-kill", daemon=True)
                 chaos_thread.start()
+            ctl_thread = None
+            stop_ctl = None
+            if (cfg.chaos.enabled and cfg.chaos.kill_controller_s > 0
+                    and cfg.control.journal_dir):
+                # Controller-crash drill ([chaos] kill_controller_s):
+                # abandon the controller mid-run — drop every client and
+                # process handle, workers untouched — then build a fresh
+                # one from the journal and prove it reattaches without a
+                # recompile storm. One-shot, gated through the injector's
+                # controller_crash_next budget so it logs like any other
+                # injection.
+                import threading
+
+                from storm_tpu.resilience.chaos import get_injector
+
+                inj = get_injector()
+                inj.bind_flight(cluster.flight)
+                inj.configure(controller_crash_next=1)
+                stop_ctl = threading.Event()
+
+                def ctl_crash_loop() -> None:
+                    if stop_ctl.wait(cfg.chaos.kill_controller_s):
+                        return
+                    if not inj.take_controller_crash():
+                        return
+                    old = holder["cluster"]
+                    monitored = old._monitor is not None
+                    print("chaos: abandoning controller (workers keep "
+                          "serving)", file=sys.stderr)
+                    old.abandon()
+                    t0 = time.monotonic()
+                    fresh = DistCluster(
+                        n_workers=args.workers,
+                        auth_token=control_token,
+                        journal_dir=cfg.control.journal_dir,
+                        reattach=True,
+                        journal_snapshot_every=(
+                            cfg.control.journal_snapshot_every),
+                    )
+                    holder["cluster"] = fresh
+                    if monitored:
+                        fresh.start_monitor()
+                    print(f"chaos: controller restarted in "
+                          f"{time.monotonic() - t0:.2f}s "
+                          f"(reattached={fresh.reattached})",
+                          file=sys.stderr)
+
+                ctl_thread = threading.Thread(
+                    target=ctl_crash_loop, name="chaos-ctl-crash",
+                    daemon=True)
+                ctl_thread.start()
             try:
                 if args.duration > 0:
                     time.sleep(args.duration)
@@ -1194,17 +1268,23 @@ def main(argv=None) -> int:
                     signal.sigwait({signal.SIGINT, signal.SIGTERM})
             except KeyboardInterrupt:
                 pass
+            if ctl_thread is not None:
+                stop_ctl.set()
+                ctl_thread.join(timeout=60)
             if chaos_thread is not None:
                 stop_chaos.set()
                 chaos_thread.join(timeout=5)
-                cluster.stop_monitor()
+                holder["cluster"].stop_monitor()
             if ui is not None:
                 asyncio.run_coroutine_threadsafe(ui.stop(), ui_loop).result(timeout=10)
                 ui_loop.call_soon_threadsafe(ui_loop.stop)
             print("draining...", file=sys.stderr)
-            cluster.drain(timeout_s=30)
-            print(json.dumps(cluster.metrics(), default=str), file=sys.stderr)
-            cluster.kill()
+            holder["cluster"].drain(timeout_s=30)
+            print(json.dumps(holder["cluster"].metrics(), default=str),
+                  file=sys.stderr)
+            holder["cluster"].kill()
+        finally:
+            holder["cluster"].shutdown()
         return 0
 
     if args.cmd == "serve":

@@ -32,7 +32,7 @@ class ChaosDrop(ConnectionError):
 
 _KNOBS = ("wire_latency_ms", "wire_jitter_ms", "wire_drop_pct",
           "corrupt_pct", "corrupt_next", "engine_hang_ms",
-          "engine_hang_next")
+          "engine_hang_next", "controller_crash_next")
 
 
 class ChaosInjector:
@@ -47,6 +47,7 @@ class ChaosInjector:
         self.corrupt_next = 0        # one-shot budget (control RPC)
         self.engine_hang_ms = 0.0
         self.engine_hang_next = 0    # one-shot budget (control RPC)
+        self.controller_crash_next = 0  # one-shot budget (driver-side)
         self.counts: Dict[str, int] = {}
 
     # ---- arming ----------------------------------------------------------
@@ -131,6 +132,20 @@ class ChaosInjector:
             hold = self.engine_hang_ms / 1e3
         self._event("engine_hang", hold_s=round(hold, 3))
         return hold
+
+    # ---- control plane ----------------------------------------------------
+
+    def take_controller_crash(self) -> bool:
+        """Consume one unit of the controller-crash budget. The DRIVER
+        polls this (main.py dist loop) — unlike the other knobs there is
+        no in-band hook for the controller to crash itself, the process
+        holding it has to decide to drop it."""
+        with self._lock:
+            if self.controller_crash_next <= 0:
+                return False
+            self.controller_crash_next -= 1
+        self._event("controller_crash")
+        return True
 
 
 _INJECTOR = ChaosInjector()

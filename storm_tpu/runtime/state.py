@@ -115,6 +115,15 @@ class FileStateBackend:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
+            # fsync the directory too: os.replace makes the rename
+            # atomic but not durable — a power cut after replace can
+            # still lose the directory entry and resurrect the OLD
+            # checkpoint (or none) on remount.
+            dfd = os.open(self.state_dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
         except BaseException:
             try:
                 os.unlink(tmp)

@@ -78,6 +78,18 @@ class _Declarer:
     ) -> "_Declarer":
         return self.grouping(source, G.PartialKeyGrouping(*fields), stream)
 
+    def ring_fields_grouping(
+        self, source: str, *fields: str, stream: str = "default"
+    ) -> "_Declarer":
+        """Fields grouping over a consistent-hash ring
+        (:class:`storm_tpu.dist.ring.RingFieldsGrouping`): same key →
+        same task, but a rebalance remaps only ~1/N of the keys instead
+        of nearly all of them — the bounded-handoff choice for keyed
+        components that scale while carrying per-key state."""
+        from storm_tpu.dist.ring import RingFieldsGrouping
+
+        return self.grouping(source, RingFieldsGrouping(*fields), stream)
+
     def direct_grouping(self, source: str, stream: str = "default") -> "_Declarer":
         """Subscribe for ``collector.emit_direct(task, ...)`` deliveries."""
         return self.grouping(source, G.DirectGrouping(), stream)

@@ -1231,3 +1231,173 @@ def test_dist_binary_wire_raw_scheme_matches_local():
             "binary wire altered prediction bytes vs the local runner"
     finally:
         stub.close()
+
+
+def test_dist_controller_reattach_and_rolling_restart(tmp_path):
+    """The durable-control-plane arc in one mesh: journal-backed submit,
+    controller death (abandon), a journaled-but-never-applied rebalance,
+    reattach that adopts both survivors WITHOUT resubmitting (warm
+    engines stay warm: pids unchanged, submit counts still 1) and
+    reconciles the missed rebalance, then a rolling restart of every
+    worker under the heartbeat monitor (drain suppression keeps the
+    monitor from racing the restart)."""
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+    stub = KafkaStubBroker(partitions=2)
+    jdir = str(tmp_path / "journal")
+    cfg = Config()
+    cfg.broker.kind = "kafka"
+    cfg.broker.bootstrap = f"127.0.0.1:{stub.port}"
+    cfg.broker.input_topic = "ra-in"
+    cfg.broker.output_topic = "ra-out"
+    cfg.broker.dead_letter_topic = "ra-dlq"
+    cfg.model.name = "lenet5"
+    cfg.model.dtype = "float32"
+    cfg.model.input_shape = (28, 28, 1)
+    cfg.offsets.policy = "earliest"
+    cfg.offsets.max_behind = None
+    cfg.batch.max_batch = 8
+    cfg.batch.max_wait_ms = 20
+    cfg.batch.buckets = (8,)
+    cfg.topology.spout_parallelism = 1
+    cfg.topology.inference_parallelism = 1
+    cfg.topology.sink_parallelism = 1
+    cfg.topology.message_timeout_s = 60.0
+    placement = {"kafka-spout": 0, "inference-bolt": 1,
+                 "kafka-bolt": 1, "dlq-bolt": 1}
+    env = {"JAX_PLATFORMS": "cpu", "STORM_TPU_PLATFORM": "cpu"}
+    rng = np.random.RandomState(0)
+
+    def feed(producer, n):
+        for _ in range(n):
+            x = rng.rand(1, 28, 28, 1).astype(np.float32)
+            producer.produce("ra-in", json.dumps({"instances": x.tolist()}))
+
+    def wait_out(n, timeout=120):
+        deadline = time.time() + timeout
+        while time.time() < deadline and stub.topic_size("ra-out") < n:
+            time.sleep(0.1)
+        assert stub.topic_size("ra-out") >= n
+
+    cluster2 = None
+    try:
+        producer = KafkaWireBroker(cfg.broker.bootstrap)
+        cluster = DistCluster(2, env=env, journal_dir=jdir)
+        assert not cluster.reattached  # empty journal: cold build
+        cluster.submit("reattach-e2e", cfg, placement)
+        pids_before = dict(cluster._pids)
+        feed(producer, 4)
+        wait_out(4)
+
+        # A rebalance journaled but never applied (controller died
+        # between the append and the RPCs): reattach must re-issue it.
+        cluster._jappend("rebalance", component="inference-bolt",
+                         parallelism=2)
+        cluster.abandon()  # controller crash; workers keep running
+
+        cluster2 = DistCluster(2, env=env, journal_dir=jdir)
+        assert cluster2.reattached
+        reports = cluster2.state_reports()
+        assert {i: r["pid"] for i, r in reports.items()} == pids_before
+        assert all(r["submits"] == 1 for r in reports.values()), \
+            "reattach recompiled a survivor"
+        assert reports[1]["parallelism"]["inference-bolt"] == 2, \
+            "journaled rebalance was not reconciled onto the worker"
+        ev = next(e for e in cluster2.flight.tail(20)
+                  if e.get("kind") == "dist_reattached")
+        assert ev["survivors"] == [0, 1] and ev["dead"] == []
+        assert ev["reconciled"] == ["inference-bolt"]
+
+        feed(producer, 4)  # adopted mesh still serves
+        wait_out(8)
+
+        # Rolling restart under the monitor: drain suppression must keep
+        # the heartbeat loop from declaring the draining worker dead and
+        # racing a second recovery against the restart.
+        cluster2.start_monitor(interval_s=0.3, misses=2)
+        rows = cluster2.rolling_restart(drain_timeout_s=30.0)
+        cluster2.stop_monitor()
+        assert [r["worker"] for r in rows] == [0, 1]
+        assert all(r["drained"] for r in rows)
+        assert all(r["new_pid"] != r["old_pid"] for r in rows)
+        assert cluster2._draining == set()
+        kinds = [e.get("kind") for e in cluster2.flight.tail(100)]
+        assert "dist_worker_draining" in kinds
+        assert "dist_worker_restarted" in kinds
+        # the monitor never declared a draining worker dead
+        assert "dist_worker_recovered" not in kinds
+
+        feed(producer, 4)  # the rolled mesh still serves
+        wait_out(12)
+        # restarted inference host kept the reconciled parallelism
+        reports = cluster2.state_reports()
+        assert reports[1]["parallelism"]["inference-bolt"] == 2
+        assert cluster2.journal_stats()["appends"] > 0
+        cluster2.kill()
+    finally:
+        if cluster2 is not None:
+            cluster2.shutdown()
+        stub.close()
+
+
+def test_dist_drain_worker_pauses_and_resumes_intake(tmp_path):
+    """Per-worker graceful drain on a live single-worker mesh: the drain
+    stops intake and flushes in-flight trees (ack path stays open), the
+    worker reports draining in its state_report, and activate re-opens
+    intake without a restart."""
+    from storm_tpu.connectors.kafka_protocol import KafkaWireBroker
+
+    stub = KafkaStubBroker(partitions=2)
+    cfg = Config()
+    cfg.broker.kind = "kafka"
+    cfg.broker.bootstrap = f"127.0.0.1:{stub.port}"
+    cfg.broker.input_topic = "dr-in"
+    cfg.broker.output_topic = "dr-out"
+    cfg.broker.dead_letter_topic = "dr-dlq"
+    cfg.model.name = "lenet5"
+    cfg.model.dtype = "float32"
+    cfg.model.input_shape = (28, 28, 1)
+    cfg.offsets.policy = "earliest"
+    cfg.offsets.max_behind = None
+    cfg.batch.max_batch = 8
+    cfg.batch.max_wait_ms = 20
+    cfg.batch.buckets = (8,)
+    cfg.topology.message_timeout_s = 60.0
+    env = {"JAX_PLATFORMS": "cpu", "STORM_TPU_PLATFORM": "cpu"}
+    rng = np.random.RandomState(1)
+    try:
+        with DistCluster(1, env=env) as cluster:
+            cluster.submit("drain-e2e", cfg)
+            producer = KafkaWireBroker(cfg.broker.bootstrap)
+            for _ in range(4):
+                x = rng.rand(1, 28, 28, 1).astype(np.float32)
+                producer.produce("dr-in",
+                                 json.dumps({"instances": x.tolist()}))
+            deadline = time.time() + 120
+            while time.time() < deadline and stub.topic_size("dr-out") < 4:
+                time.sleep(0.1)
+            assert stub.topic_size("dr-out") >= 4
+
+            res = cluster.drain_worker(0, timeout_s=30.0)
+            assert res["ok"] and res["flushed"]
+            assert cluster.clients[0].control("state_report")["draining"]
+            assert 0 in cluster._draining
+            # records produced while drained stay in the log (intake off)
+            n0 = stub.topic_size("dr-out")
+            for _ in range(3):
+                x = rng.rand(1, 28, 28, 1).astype(np.float32)
+                producer.produce("dr-in",
+                                 json.dumps({"instances": x.tolist()}))
+            time.sleep(1.5)
+            assert stub.topic_size("dr-out") == n0
+
+            cluster.clients[0].control("activate")
+            cluster.clear_drain(0)
+            assert not cluster.clients[0].control("state_report")["draining"]
+            deadline = time.time() + 60
+            while time.time() < deadline and stub.topic_size("dr-out") < n0 + 3:
+                time.sleep(0.1)
+            assert stub.topic_size("dr-out") >= n0 + 3  # intake resumed
+            cluster.kill()
+    finally:
+        stub.close()

@@ -252,3 +252,54 @@ def test_chaos_artifact_gates():
 
     assert art["capture_session"].startswith("cap-")
     assert art["code_version"]
+
+
+def test_failover_artifact_gates():
+    """BENCH_FAILOVER_r15.json backs the round-15 durable-control-plane
+    docs: a SIGKILLed controller on a 3-worker mesh whose replacement
+    reattaches to every journaled survivor in bounded time with ZERO
+    engine recompiles (same worker pids, per-worker submit counts still
+    1), the orphaned mesh serving throughout, a rolling restart whose
+    10 s goodput windows never drop below half the baseline median, and
+    the exactly-once drain drill auditing clean on the transactional
+    path — all from one capture session."""
+    import json
+
+    art = json.loads((REPO / "BENCH_FAILOVER_r15.json").read_text())
+    assert art["metric"] == "controller_failover_dist3_cpu"
+
+    # Reattach: all three survivors adopted, fast, with warm engines.
+    ra = art["reattach"]
+    assert ra["reattach_s"] <= 10.0
+    assert ra["survivors"] == [0, 1, 2] and ra["dead"] == []
+    assert ra["zero_recompile"] is True
+    assert ra["worker_pids_after"] == ra["worker_pids_before"]
+    assert all(s == 1 for s in ra["submits_per_worker"].values())
+    assert ra["replayed_records"] >= 1  # the WAL, not a rebuild, drove it
+
+    # The data plane does not route through the controller: goodput never
+    # hit zero while no controller existed.
+    assert art["controller_down"]["served_without_controller"] is True
+
+    # Rolling restart under load: every worker drained and changed pid,
+    # and every 10 s window held >= 50% of the baseline median.
+    roll = art["rolling_restart"]
+    assert len(roll["workers"]) == 3
+    assert all(r["drained"] for r in roll["workers"])
+    assert all(r["new_pid"] != r["old_pid"] for r in roll["workers"])
+    assert roll["floor_met"] is True and roll["floor_ratio"] >= 0.5
+
+    # The flight recorder saw the arc: reattach, per-worker drain+restart.
+    kinds = [ev["kind"] for ev in art["flight"]["controller"]]
+    assert "dist_reattached" in kinds
+    assert kinds.count("dist_worker_draining") >= 3
+    assert kinds.count("dist_worker_restarted") >= 3
+
+    # Exactly-once drain drill (transactional path) audited clean.
+    eo = art["exactly_once"]
+    assert eo["exactly_once"] is True
+    assert eo["audit"]["echo_duplicated"] == 0
+    assert eo["audit"]["echo_missing"] == 0
+
+    assert art["capture_session"].startswith("cap-")
+    assert art["code_version"]

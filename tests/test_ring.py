@@ -1,0 +1,116 @@
+"""Consistent-hash ring tests (storm_tpu/dist/ring.py): balance,
+bounded remap under membership change, and the RingFieldsGrouping
+contract (same key -> same task; prepare() diff-updates instead of
+rebinding ``% n``)."""
+
+from collections import Counter
+
+import pytest
+
+from storm_tpu.dist.ring import HashRing, RingFieldsGrouping
+from storm_tpu.runtime.tuples import Tuple
+
+
+def _t(key):
+    return Tuple([key], ("user",), "spout")
+
+
+def test_lookup_deterministic_and_balanced():
+    ring = HashRing(range(4))
+    counts = Counter(ring.lookup_key(f"k{i}") for i in range(4000))
+    assert set(counts) == {0, 1, 2, 3}
+    # 64 vnodes: every member within a loose 2x band of fair share
+    assert min(counts.values()) > 4000 / 4 / 2
+    assert max(counts.values()) < 4000 / 4 * 2
+    # same key, same owner, across independently built rings
+    ring2 = HashRing(range(4))
+    assert all(ring.lookup_key(f"k{i}") == ring2.lookup_key(f"k{i}")
+               for i in range(100))
+
+
+def test_empty_ring_raises():
+    with pytest.raises(LookupError):
+        HashRing().lookup(123)
+
+
+def test_grow_remaps_about_one_nth():
+    """Adding one member to N moves ~1/(N+1) of the keyspace — the
+    bounded-handoff property modulo hashing can't provide."""
+    old = HashRing(range(4))
+    new = HashRing(range(4))
+    new.add(4)
+    moved = old.moved_fraction(new)
+    assert 0.08 < moved < 0.35  # ideal 0.20; vnodes=64 keeps it close
+    # and the moved keys all landed on the NEW member
+    for h in range(0, 1 << 32, (1 << 32) // 512):
+        if old.lookup(h) != new.lookup(h):
+            assert new.lookup(h) == 4
+
+
+def test_shrink_remaps_only_lost_arcs():
+    old = HashRing(range(5))
+    new = HashRing(range(5))
+    new.remove(4)
+    moved = old.moved_fraction(new)
+    assert 0.08 < moved < 0.35
+    # survivors keep every key they already owned
+    for h in range(0, 1 << 32, (1 << 32) // 512):
+        if old.lookup(h) != 4:
+            assert new.lookup(h) == old.lookup(h)
+
+
+def test_modulo_grouping_remaps_nearly_everything():
+    """The contrast motivating the ring: % n moves almost every key."""
+    moved = sum(1 for h in range(10_000) if h % 4 != h % 5)
+    assert moved / 10_000 > 0.7
+
+
+def test_grouping_same_key_same_task():
+    g = RingFieldsGrouping("user")
+    g.prepare(4)
+    tasks = {g.choose(_t("alice"))[0] for _ in range(10)}
+    assert len(tasks) == 1
+    assert g.choose(_t("alice")) == g.choose(_t("alice"))
+
+
+def test_grouping_prepare_diff_update():
+    g = RingFieldsGrouping("user")
+    g.prepare(4)
+    before = {k: g.choose(_t(k))[0] for k in (f"u{i}" for i in range(500))}
+    g.prepare(5)  # rebalance: grow by one task
+    after = {k: g.choose(_t(k))[0] for k in before}
+    moved = sum(1 for k in before if before[k] != after[k])
+    assert moved / len(before) < 0.35     # ~1/5 ideal; NOT ~4/5
+    assert 0.0 < g.last_remap_fraction < 0.35
+    assert g.remaps == 1
+    assert all(t < 5 for t in after.values())
+    # same-size re-prepare (router rebuilds) is a no-op
+    g.prepare(5)
+    assert g.remaps == 1
+
+
+def test_grouping_requires_fields():
+    with pytest.raises(ValueError):
+        RingFieldsGrouping()
+
+
+def test_declarer_wires_ring_grouping():
+    from storm_tpu.runtime import TopologyBuilder
+    from storm_tpu.runtime.base import Bolt, Spout
+
+    class S(Spout):
+        async def next_tuple(self):
+            return None
+
+    class B(Bolt):
+        async def execute(self, t):
+            pass
+
+    tb = TopologyBuilder()
+    tb.set_spout("spout", S())
+    tb.set_bolt("bolt", B(), parallelism=3).ring_fields_grouping(
+        "spout", "user")
+    topo = tb.build()
+    sub = topo.specs["bolt"].inputs[0]
+    assert isinstance(sub.grouping, RingFieldsGrouping)
+    assert sub.grouping.field_names == ("user",)

@@ -73,6 +73,25 @@ def test_file_backend_roundtrip(tmp_path):
     assert all(not p.name.endswith(".tmp") for p in tmp_path.iterdir())
 
 
+def test_file_backend_fsyncs_directory(tmp_path, monkeypatch):
+    """save() must fsync the state DIRECTORY after os.replace: the rename
+    is atomic but not durable, and losing the directory entry on a power
+    cut would silently resurrect the previous checkpoint."""
+    import os
+
+    synced_inodes = set()
+    real_fsync = os.fsync
+
+    def spy_fsync(fd):
+        synced_inodes.add(os.fstat(fd).st_ino)
+        real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    b = FileStateBackend(str(tmp_path))
+    b.save("count-bolt", 0, 1, {"k": 1})
+    assert tmp_path.stat().st_ino in synced_inodes
+
+
 # ---- integration: checkpoint + restore ---------------------------------------
 
 
