@@ -3335,6 +3335,31 @@ def run_slo_burn(args) -> dict:
     }
 
 
+def run_fleet_matrix(args) -> dict:
+    """``--fleet``: the trace-driven scenario x pattern matrix
+    (storm_tpu/loadgen). Each cell replays a seeded trace — heavy-tailed
+    tenants, a diurnal wave, or a flash crowd — against one serving
+    scenario (classify, cascade, continuous, serve-path) with the full
+    protection stack live, and is scored on goodput, per-lane p99, SLO
+    burn, and shed fraction against declared targets. The committed
+    ``SCORECARD_r<N>.json`` is the regression surface future PRs diff
+    against instead of a single paced run; traces regenerate
+    byte-identically from the recorded spec+seed."""
+    from storm_tpu.loadgen.fleet import run_fleet
+
+    scenarios = None
+    if args.fleet_scenarios:
+        scenarios = tuple(s.strip() for s in
+                          args.fleet_scenarios.split(",") if s.strip())
+    kw = {}
+    if scenarios:
+        kw["scenarios"] = scenarios
+    out = run_fleet(args, **kw)
+    out["capture_session"] = _new_capture_session()
+    out["code_version"] = _code_version()
+    return out
+
+
 def run_bottleneck(args) -> dict:
     """``--bottleneck``: the bottleneck observatory made to name a KNOWN
     limiter, induced both ways on the same DAG shape:
@@ -4383,6 +4408,19 @@ def main() -> None:
                          "attached: burn-rate gauge vs shed_level "
                          "timeline + live /profile route probe -> "
                          "BENCH_SLO_BURN artifact")
+    ap.add_argument("--fleet", action="store_true",
+                    help="trace-driven fleet matrix: every scenario "
+                         "(classify/cascade/continuous/serve-path) x every "
+                         "traffic pattern (heavy-tail/diurnal/flash-crowd) "
+                         "scored on goodput, per-lane p99, SLO burn, and "
+                         "shed fraction -> SCORECARD artifact")
+    ap.add_argument("--fleet-scenarios", default=None,
+                    help="comma list restricting --fleet scenarios "
+                         "(default: all four)")
+    ap.add_argument("--seed", type=int, default=16,
+                    help="base RNG seed for --fleet trace generation "
+                         "(recorded per cell; same seed -> byte-identical "
+                         "traces)")
     ap.add_argument("--bottleneck", action="store_true",
                     help="bottleneck attributor vs two induced limiters "
                          "(inference-bound lenet5 vs spout-bound null "
@@ -4422,6 +4460,9 @@ def main() -> None:
         return
     if args.slo_burn:
         print(json.dumps(run_slo_burn(args)))
+        return
+    if args.fleet:
+        print(json.dumps(run_fleet_matrix(args)))
         return
     if args.bottleneck:
         print(json.dumps(run_bottleneck(args)))

@@ -82,6 +82,13 @@ def main() -> int:
     ap.add_argument("--seconds", type=float, default=660.0,
                     help="feed duration (events are scheduled across it)")
     ap.add_argument("--rate", type=float, default=30.0, help="records/sec")
+    ap.add_argument("--trace", default=None,
+                    help="replay a storm_tpu.loadgen trace file as the "
+                         "feed source (event schedule + tenant:lane keys) "
+                         "instead of fixed-interval pacing; loops until "
+                         "--seconds elapse")
+    ap.add_argument("--trace-speed", type=float, default=1.0,
+                    help="time-compression factor for --trace replay")
     ap.add_argument("--out", default="SOAK_r05.json")
     ap.add_argument("--slo-ms", type=float, default=1000.0,
                     help="per-window sink p50 target for the SLO timeline")
@@ -201,7 +208,30 @@ def main() -> int:
     stop_feed = threading.Event()
     fed = [0]
 
+    def _produce_one(key=None):
+        payload = json.dumps(
+            {"instances": rng.rand(1, 32, 32, 1).round(4).tolist()})
+        produced_hashes.append(
+            hashlib.sha256(payload.encode()).hexdigest()[:24])
+        feeder.produce(IN, payload, key=key, partition=fed[0] % P)
+        fed[0] += 1
+
     def feed():
+        if args.trace:
+            # Trace-driven soak source (storm_tpu.loadgen): the recorded
+            # arrival schedule paces production and each record carries
+            # its tenant:lane key, so the soak sees fleet-shaped traffic
+            # (bursts, tenant skew) instead of a metronome. The trace
+            # loops until the run ends; the identity audit is unchanged —
+            # it counts records, not pacing.
+            from storm_tpu.loadgen import load_trace, replay
+
+            tr = load_trace(args.trace)
+            while not stop_feed.is_set():
+                replay(tr, lambda ev: _produce_one(key=ev.key()),
+                       speed=args.trace_speed,
+                       stop=stop_feed.is_set)
+            return
         interval = 1.0 / args.rate
         nxt = time.perf_counter()
         while not stop_feed.is_set():
@@ -209,12 +239,7 @@ def main() -> int:
             if now < nxt:
                 time.sleep(min(0.01, nxt - now))
                 continue
-            payload = json.dumps(
-                {"instances": rng.rand(1, 32, 32, 1).round(4).tolist()})
-            produced_hashes.append(
-                hashlib.sha256(payload.encode()).hexdigest()[:24])
-            feeder.produce(IN, payload, partition=fed[0] % P)
-            fed[0] += 1
+            _produce_one()
             nxt += interval
 
     events = []  # (t_s, name, detail)
@@ -407,7 +432,10 @@ def main() -> int:
         "platform": device.platform,
         "device_kind": device.device_kind,
         "duration_s": round(args.seconds, 1),
-        "offered_rate_msg_s": args.rate,
+        "offered_rate_msg_s": args.rate if not args.trace else None,
+        "trace_source": (os.path.basename(args.trace) if args.trace
+                         else None),
+        "trace_speed": args.trace_speed if args.trace else None,
         "records_in": n,
         "records_out": len(out_records),
         "transport": "SASL_SSL + SCRAM-SHA-256 (2-node stub, "

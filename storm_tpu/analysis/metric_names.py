@@ -61,6 +61,7 @@ METRIC_NAMES = frozenset({
     'inbox_depth',
     'ingest_lag_ms',
     'instances_inferred',
+    'offered_records',
     'plan_active',
     'plan_corrections',
     'produce_ms',
@@ -91,6 +92,7 @@ METRIC_PATTERNS = (
     'e2e_latency_ms_*',
     'fair_rows_*_*',
     'fair_starved_*_*',
+    'offered_lane_*',
     'shed_*',
     'shed_lane_*',
     'throttled_*',
@@ -143,6 +145,7 @@ METRIC_KINDS = {
     'inbox_depth': ('gauge',),
     'ingest_lag_ms': ('histogram',),
     'instances_inferred': ('counter',),
+    'offered_records': ('counter',),
     'plan_active': ('gauge',),
     'plan_corrections': ('counter',),
     'produce_ms': ('histogram',),

@@ -577,6 +577,53 @@ def _profile_cmd(args) -> int:
     return 0
 
 
+def _scorecard_cmd(args) -> int:
+    """Render the fleet scenario-matrix scorecard (storm_tpu/loadgen):
+    one row per (scenario, traffic pattern) cell with goodput, protected-
+    lane p99, burn, shed fraction, the bottleneck verdict, and the
+    declared-target pass/fail. Offline mode (``--file``) renders a
+    committed SCORECARD_*.json; online mode queries the /scorecard route
+    the fleet driver attaches mid-run."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from storm_tpu.config import env_control_token
+    from storm_tpu.loadgen.scorecard import render_table
+
+    if args.file:
+        with open(args.file) as f:
+            out = json.load(f)
+    else:
+        if not args.topology:
+            print("scorecard: give a topology name or --file "
+                  "SCORECARD_*.json", file=sys.stderr)
+            return 2
+        base = args.url.rstrip("/")
+        topo = urllib.parse.quote(args.topology, safe="")
+        req = urllib.request.Request(
+            f"{base}/api/v1/topology/{topo}/scorecard")
+        token = args.token or env_control_token()
+        if token:  # read route is open; header is harmless if unneeded
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            print(e.read().decode("utf-8", "replace"), file=sys.stderr)
+            return 1
+        except urllib.error.URLError as e:
+            print(f"cannot reach {base}: {e}", file=sys.stderr)
+            return 2
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    print(render_table(out))
+    if out.get("in_progress"):
+        print("(matrix still running: cells land as they are scored)")
+    return 0
+
+
 def _bottleneck_cmd(args) -> int:
     """Render the bottleneck observatory's verdict from a running
     topology's UI endpoint (storm-tpu bottleneck <topology>): ranked
@@ -1061,6 +1108,25 @@ def main(argv=None) -> int:
     planp.add_argument("--json", action="store_true",
                        help="raw JSON instead of the rendered view")
 
+    scorep = sub.add_parser(
+        "scorecard",
+        help="render the fleet scenario-matrix scorecard as a table: "
+             "live from a running topology's /scorecard route (attached "
+             "mid-run by bench.py --fleet), or offline from a committed "
+             "SCORECARD_*.json via --file")
+    scorep.add_argument("topology", nargs="?", default=None,
+                        help="topology to query (omit with --file)")
+    scorep.add_argument("--file", default=None,
+                        help="render this SCORECARD_*.json instead of "
+                             "querying a running topology")
+    scorep.add_argument("--url", default="http://127.0.0.1:8080",
+                        help="base URL of the daemon's --ui-port server")
+    scorep.add_argument("--token", default=None,
+                        help="bearer token (default: "
+                             "$STORM_TPU_CONTROL_TOKEN)")
+    scorep.add_argument("--json", action="store_true",
+                        help="raw JSON instead of the rendered table")
+
     lintp = sub.add_parser(
         "lint",
         help="run the project's invariant analyzer (lock discipline, "
@@ -1121,6 +1187,9 @@ def main(argv=None) -> int:
 
     if args.cmd == "plan":
         return _plan_cmd(args)
+
+    if args.cmd == "scorecard":
+        return _scorecard_cmd(args)
 
     if args.cmd == "dist-run":
         cfg = _load_config(args)

@@ -88,8 +88,17 @@ class CapacityTracker:
             row["wait_s"] += max(0.0, wait - prev[1])
             row["flush_s"] += max(0.0, flush - prev[2])
             row["dt_s"] = max(row["dt_s"], max(0.0, now - prev[3]))
-        for tkey in [k for k in cur if k not in seen]:
-            del cur[tkey]  # rebalance removed the task; drop its cursor
+        # Rebalance removed a task: drop its tuple from EVERY named cursor,
+        # not just the one being sampled — the sampled key self-heals on its
+        # next call, but an idle consumer's key (a finished scorecard cell,
+        # a paused dist poller) would otherwise pin stale (comp, task)
+        # state for the tracker's lifetime. The executor set is a property
+        # of the runtime, so `seen` is valid for all keys at once.
+        for ckey, cdict in list(self._cursors.items()):
+            for tkey in [k for k in cdict if k not in seen]:
+                del cdict[tkey]
+            if not cdict and ckey != key:
+                del self._cursors[ckey]
         for row in per_comp.values():
             _finish_row(row)
         self.last = per_comp
@@ -102,6 +111,18 @@ class CapacityTracker:
                 g(comp, "wait_frac").set(row["wait_frac"])
                 g(comp, "flush_frac").set(row["flush_frac"])
         return per_comp
+
+    def drop(self, key: str) -> bool:
+        """Forget a named cursor wholesale — the tracker-side twin of
+        ``Histogram.drop_window``. A consumer whose lifetime is shorter
+        than the topology's (one scorecard cell, a one-shot bench probe)
+        calls this on exit; without it each retired key keeps a
+        per-(component, task) tuple dict alive forever."""
+        return self._cursors.pop(key, None) is not None
+
+    def cursor_keys(self) -> tuple:
+        """Live cursor names (leak check for long-running harnesses)."""
+        return tuple(self._cursors)
 
 
 def _finish_row(row: dict) -> None:

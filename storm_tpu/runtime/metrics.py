@@ -145,6 +145,21 @@ class Histogram:
             "mean": ds / dc if dc else None,
         }
 
+    def drop_window(self, key: str = "default") -> bool:
+        """Forget one named cursor. Consumers that come and go (a scorecard
+        cell, a finished bench sampler) must drop their cursor on exit or
+        every key they ever used stays resident for the histogram's
+        lifetime — ``window`` creates cursors implicitly and ``reset`` is
+        too blunt (it discards the reservoir every other consumer is
+        still reading)."""
+        with self._lock:
+            return self._windows.pop(key, None) is not None
+
+    def window_keys(self) -> tuple:
+        """Live cursor names (leak check for long-running harnesses)."""
+        with self._lock:
+            return tuple(self._windows)
+
     def snapshot(self) -> Dict[str, float]:
         def clean(v: float):
             return None if v != v else v  # NaN -> None (JSON-safe)
@@ -206,6 +221,19 @@ class MetricsRegistry:
             with self._lock:
                 h = self._histograms.setdefault(key, Histogram())
         return h
+
+    def drop_windows(self, key: str) -> int:
+        """Drop the named ``window()`` cursor from every histogram in the
+        registry; returns how many held one. The registry-level sweep a
+        departing consumer calls so one forgotten histogram doesn't keep
+        its per-key tuple alive for the rest of the topology's life."""
+        n = 0
+        with self._lock:
+            hists = list(self._histograms.values())
+        for h in hists:
+            if h.drop_window(key):
+                n += 1
+        return n
 
     def snapshot(self) -> Dict[str, Dict[str, object]]:
         out: Dict[str, Dict[str, object]] = {}

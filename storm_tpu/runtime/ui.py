@@ -22,6 +22,7 @@ Read routes
                                               flight tail (?n=20)
     GET /api/v1/topology/{name}/flight        flight-recorder events only
     GET /api/v1/topology/{name}/qos           admission/shed state
+    GET /api/v1/topology/{name}/scorecard     fleet scenario-matrix scores
     GET /api/v1/topology/{name}/cascade       per-tier engines + escalation
     GET /api/v1/topology/{name}/bottleneck    per-component utilization +
                                               ranked bottleneck verdict
@@ -423,6 +424,19 @@ class UIServer:
 
                 out["continuous"] = await asyncio.to_thread(registry_stats)
                 return 200, out
+            if action == "scorecard":
+                # Fleet scenario-matrix scorecard (storm_tpu/loadgen): the
+                # fleet driver attaches its accumulated matrix to the
+                # runtime it is currently driving (rt.scorecard), so an
+                # operator can watch cells land mid-run; 404 on topologies
+                # no fleet drill is scoring.
+                if method != "GET":
+                    return 405, {"error": "use GET"}
+                sc = getattr(rt, "scorecard", None)
+                if sc is None:
+                    return 404, {"error": "no scorecard attached (run "
+                                          "bench.py --fleet)"}
+                return 200, {"topology": rt.name, **sc}
             if action == "cascade":
                 # Tiered-serving state: per-tier engine attribution (model,
                 # checkpoint, gate, HBM) from every cascading bolt executor

@@ -28,12 +28,21 @@ class RemoteInferenceBolt(InferenceBolt):
         target: str = "localhost:50051",
         batch: Optional[BatchConfig] = None,
         warmup: bool = False,
+        qos=None,
+        passthrough=(),
     ) -> None:
-        super().__init__(batch=batch, warmup=warmup)
+        # qos/passthrough forward unchanged: EDF lane formation and the
+        # qos_lane ride-through happen in the batcher/operator layer,
+        # which is identical on both sides of the gRPC boundary — the
+        # fleet scorecard's serve-path cells need per-lane e2e histograms
+        # from a remote topology too.
+        super().__init__(batch=batch, warmup=warmup, qos=qos,
+                         passthrough=passthrough)
         self.target = target
 
     def clone(self) -> "RemoteInferenceBolt":
-        return RemoteInferenceBolt(self.target, self.batch_cfg, self._warmup)
+        return RemoteInferenceBolt(self.target, self.batch_cfg, self._warmup,
+                                   self.qos, self.passthrough)
 
     def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
         # Skip the in-process engine entirely; resolve shape from the worker.

@@ -581,3 +581,36 @@ def test_ui_no_token_stays_open(run):
             await cluster.shutdown()
 
     run(go(), timeout=60)
+
+
+def test_ui_scorecard_route(run):
+    async def go():
+        cluster, ui = await _cluster_with_ui()
+        try:
+            # No fleet drill scoring this topology: 404, not an empty 200.
+            st, r = await _http(ui.port, "GET",
+                                "/api/v1/topology/demo/scorecard")
+            assert st == 404
+
+            # The fleet driver attaches its accumulated matrix to the
+            # runtime mid-run; the route serves it read-only.
+            rt = cluster.runtime("demo")
+            rt.scorecard = {"metric": "fleet_scorecard_cells_passed",
+                            "seed": 16, "in_progress": True,
+                            "cells": [{"scenario": "classify",
+                                       "pattern": "flash_crowd",
+                                       "ok": True}]}
+            st, r = await _http(ui.port, "GET",
+                                "/api/v1/topology/demo/scorecard")
+            assert st == 200
+            assert r["topology"] == "demo" and r["seed"] == 16
+            assert r["cells"][0]["pattern"] == "flash_crowd"
+
+            st, _ = await _http(ui.port, "POST",
+                                "/api/v1/topology/demo/scorecard")
+            assert st == 405
+        finally:
+            await ui.stop()
+            await cluster.shutdown()
+
+    run(go(), timeout=60)
