@@ -1,10 +1,18 @@
 """storm-tpu's project-specific static analyzer (``storm-tpu lint``).
 
-Four invariant checkers over the package's own AST — lock discipline
-(LCK001/LCK002), exactly-once tuple handling (XO001), jit tracer hygiene
-(JIT001-004), and observability hygiene (OBS001-003) — gated in tier-1
-against the committed ``analysis/baseline.json``. See
-docs/ARCHITECTURE.md "Statically checked invariants" and the
+Rule families over the package's own AST, gated in tier-1 against the
+committed ``analysis/baseline.json``:
+
+* lock discipline — direct (LCK001/LCK002) and interprocedural
+  (LCK003 transitive blocking, LCK004 full lock-order cycles), built on
+  the project call graph (``analysis/callgraph.py``);
+* thread/executor lifecycle (THR001/THR002);
+* protocol conformance (PRT001-003) against the generated
+  ``analysis/protocol_names.py`` registry;
+* exactly-once tuple handling (XO001), jit tracer hygiene (JIT001-004),
+  and observability hygiene (OBS001-003).
+
+See docs/ARCHITECTURE.md "Statically checked invariants" and the
 docs/OPERATIONS.md runbook.
 
 Kept import-light: ``runtime/metrics.py`` imports

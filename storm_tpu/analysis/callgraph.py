@@ -1,0 +1,472 @@
+"""Project-wide call graph with blocking and lock summaries.
+
+The PR 9 checkers are intraprocedural: LCK001 only sees a blocking call
+written *directly* under the ``with lock:``, so ``recover_worker()``
+holding the controller lock while calling ``self._reship()`` — which five
+lines later issues a blocking control RPC — passes clean. This module is
+the interprocedural tier the depth-N rules (LCK003/LCK004, THR001/THR002)
+are built on:
+
+* **Resolution.** Intra-project calls are resolved by name: module
+  functions, imported functions/classes (absolute and relative imports),
+  ``self.``/``cls.`` methods (with base-class walk), ``self.attr.meth()``
+  through attribute types inferred from ``self.attr = Cls(...)``
+  assignments, and ``var.meth()`` through function-local ``var = Cls(...)``
+  assignments. Anything dynamic stays unresolved — the graph is
+  deliberately under-approximate, so every edge it reports is real.
+
+* **Blocking summaries.** Seeded from the LCK blocking table (plus
+  ``[tool.storm-tpu.lint] blocking_methods``), propagated to a fixed point
+  over the call graph by BFS from the directly-blocking functions — so
+  each function carries a *shortest witness chain* down to the concrete
+  blocking call (``recover_worker -> _reship -> client.control``), which
+  LCK003 prints in its finding detail.
+
+* **Lock summaries.** The set of lock keys a function may acquire,
+  directly or transitively.  Combined with the per-call held-lock context
+  recorded by the LCK walker, this yields the *interprocedural*
+  acquisition edges (caller holds A, callee eventually takes B) that
+  LCK004 feeds into full cycle detection.
+
+Like every checker here this is a pure AST pass: nothing in the checked
+tree is imported or executed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from storm_tpu.analysis import locks
+from storm_tpu.analysis.core import (
+    LintConfig,
+    SourceFile,
+    dotted_name,
+)
+
+
+def module_of(path: str) -> str:
+    """Dotted module name for a repo-relative path (packages collapse:
+    ``storm_tpu/analysis/__init__.py`` -> ``storm_tpu.analysis``)."""
+    mod = path[:-3] if path.endswith(".py") else path
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+@dataclass
+class FunctionNode:
+    """One function/method (or the module top level, scope ``<module>``)."""
+
+    qual: str  # "storm_tpu.dist.worker:PeerSender._flush"
+    module: str
+    scope: str
+    path: str
+    line: int = 0
+    calls: List[locks.CallRecord] = field(default_factory=list)
+    local_types: Dict[str, str] = field(default_factory=dict)
+    resolved: List[str] = field(default_factory=list)  # callee quals
+    call_raw: Dict[str, str] = field(default_factory=dict)  # qual -> raw text
+    acquires: Set[str] = field(default_factory=set)
+    blocking: List[Tuple[str, int]] = field(default_factory=list)
+    may_block: bool = False
+    block_via: Optional[str] = None  # next hop toward the blocking call
+    block_reason: str = ""  # direct reason when this node is the seed
+    trans_acquires: Set[str] = field(default_factory=set)
+
+    @property
+    def name(self) -> str:
+        return self.scope.rsplit(".", 1)[-1]
+
+
+@dataclass
+class ClassNode:
+    qual: str  # "storm_tpu.dist.worker:PeerSender"
+    module: str
+    name: str
+    path: str
+    bases: List[str] = field(default_factory=list)  # raw dotted names
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> func qual
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> raw ctor
+
+
+class LockedCall:
+    """One call site executed while at least one lock is held."""
+
+    __slots__ = ("path", "module", "scope", "raw", "line", "held", "reason")
+
+    def __init__(self, path: str, module: str, scope: str, raw: str,
+                 line: int, held: Tuple[str, ...],
+                 reason: Optional[str]) -> None:
+        self.path = path
+        self.module = module
+        self.scope = scope
+        self.raw = raw
+        self.line = line
+        self.held = held
+        self.reason = reason  # LCK001 reason, if the call blocks directly
+
+
+#: function names that count as externally-driven lifecycle entry points
+#: for THR001's "join must be reachable from a shutdown path" check.
+_LIFECYCLE = re.compile(
+    r"close|shutdown|stop|kill|drain|exit|finali[sz]e|join|serve|atexit"
+    r"|teardown|cleanup|main|wait|__del__|reap", re.I)
+
+_MAX_MRO_DEPTH = 8
+
+
+class CallGraph:
+    """Build once per lint run from the already-parsed ``SourceFile``s."""
+
+    def __init__(self, files: Sequence[SourceFile],
+                 config: Optional[LintConfig] = None) -> None:
+        self.config = config or LintConfig()
+        self.functions: Dict[str, FunctionNode] = {}
+        self.classes: Dict[str, ClassNode] = {}
+        self.imports: Dict[str, Dict[str, str]] = {}
+        self.modules: Set[str] = set()
+        self.locked_calls: List[LockedCall] = []
+        #: syntactic same-function acquisition edges from the LCK walker
+        self.lock_edges: List[Tuple[str, str, str, int, str]] = []
+        self._lifecycle_reach: Optional[Set[str]] = None
+        for sf in files:
+            self._index_defs(sf)
+        for sf in files:
+            self._attach_records(sf)
+        self._resolve_all()
+        self._summarize()
+
+    # -- indexing ---------------------------------------------------------
+
+    def _index_defs(self, sf: SourceFile) -> None:
+        module = module_of(sf.path)
+        self.modules.add(module)
+        imp = self.imports.setdefault(module, {})
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        imp[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        imp.setdefault(head, head)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    # level=1 -> current package; module names collapse
+                    # __init__, so a module's package is itself minus the
+                    # last segment (a package's package is itself).
+                    pkg = module.split(".")
+                    if not sf.path.endswith("/__init__.py"):
+                        pkg = pkg[:-1]
+                    pkg = pkg[: len(pkg) - (node.level - 1)]
+                    base = ".".join(pkg + ([node.module] if node.module
+                                           else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    target = f"{base}.{a.name}" if base else a.name
+                    imp[a.asname or a.name] = target
+        self._walk_defs(sf, sf.tree.body, [], None, module, direct=False)
+        self._ensure_func(module, "<module>", sf.path, 0)
+
+    def _walk_defs(self, sf: SourceFile, body, scope_parts: List[str],
+                   owner: Optional[ClassNode], module: str,
+                   direct: bool) -> None:
+        for st in body:
+            if isinstance(st, ast.ClassDef):
+                cname = ".".join(scope_parts + [st.name])
+                cn = ClassNode(
+                    qual=f"{module}:{cname}", module=module, name=cname,
+                    path=sf.path,
+                    bases=[dotted_name(b) for b in st.bases
+                           if dotted_name(b)])
+                self.classes[cn.qual] = cn
+                self._walk_defs(sf, st.body, scope_parts + [st.name], cn,
+                                module, direct=True)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = ".".join(scope_parts + [st.name])
+                fn = self._ensure_func(module, scope, sf.path, st.lineno)
+                if owner is not None and direct:
+                    owner.methods.setdefault(st.name, fn.qual)
+                self._collect_types(st, fn, owner)
+                self._walk_defs(sf, st.body, scope_parts + [st.name],
+                                owner, module, direct=False)
+
+    def _collect_types(self, func, fn: FunctionNode,
+                       owner: Optional[ClassNode]) -> None:
+        for node in ast.walk(func):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)):
+                continue
+            raw = dotted_name(node.value.func)
+            if not raw:
+                continue
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                fn.local_types.setdefault(tgt.id, raw)
+            elif (owner is not None and isinstance(tgt, ast.Attribute)
+                  and isinstance(tgt.value, ast.Name)
+                  and tgt.value.id == "self"):
+                owner.attr_types.setdefault(tgt.attr, raw)
+
+    def _ensure_func(self, module: str, scope: str, path: str,
+                     line: int) -> FunctionNode:
+        qual = f"{module}:{scope}"
+        fn = self.functions.get(qual)
+        if fn is None:
+            fn = FunctionNode(qual=qual, module=module, scope=scope,
+                              path=path, line=line)
+            self.functions[qual] = fn
+        elif line and not fn.line:
+            fn.line = line
+        return fn
+
+    # -- walker records ---------------------------------------------------
+
+    def _attach_records(self, sf: SourceFile) -> None:
+        module = module_of(sf.path)
+        w = locks._LockWalker(sf, self.config)
+        w.run()
+        self.lock_edges.extend(w.edges)
+        for scope, key, _line in w.acquisitions:
+            self._ensure_func(module, scope, sf.path, 0).acquires.add(key)
+        for rec in w.calls:
+            fn = self._ensure_func(module, rec.scope, sf.path, 0)
+            fn.calls.append(rec)
+            if rec.summary_reason:
+                fn.blocking.append((rec.summary_reason, rec.line))
+            if rec.held:
+                self.locked_calls.append(LockedCall(
+                    sf.path, module, rec.scope, rec.raw, rec.line,
+                    rec.held, rec.reason))
+
+    # -- resolution -------------------------------------------------------
+
+    def _owning_class(self, module: str, scope: str) -> Optional[ClassNode]:
+        best: Optional[ClassNode] = None
+        name = ""
+        for p in scope.split("."):
+            name = f"{name}.{p}" if name else p
+            cn = self.classes.get(f"{module}:{name}")
+            if cn is None:
+                break
+            best = cn
+        return best
+
+    def _class_from_raw(self, module: str,
+                        raw: str, depth: int = 0) -> Optional[ClassNode]:
+        if not raw or depth > _MAX_MRO_DEPTH:
+            return None
+        cn = self.classes.get(f"{module}:{raw}")
+        if cn is not None:
+            return cn
+        imp = self.imports.get(module, {})
+        parts = raw.split(".")
+        target = imp.get(parts[0])
+        if target is None:
+            return None
+        if len(parts) == 1:
+            head, _, tail = target.rpartition(".")
+            return self.classes.get(f"{head}:{tail}")
+        # "mod.Cls" through an imported module
+        if target in self.modules:
+            return self.classes.get(f"{target}:{'.'.join(parts[1:])}")
+        return None
+
+    def _method(self, cls: Optional[ClassNode], name: str,
+                depth: int = 0) -> Optional[str]:
+        if cls is None or depth > _MAX_MRO_DEPTH:
+            return None
+        if name in cls.methods:
+            return cls.methods[name]
+        for braw in cls.bases:
+            q = self._method(self._class_from_raw(cls.module, braw, depth + 1),
+                             name, depth + 1)
+            if q:
+                return q
+        return None
+
+    def _attr_class(self, cls: Optional[ClassNode], attr: str,
+                    depth: int = 0) -> Optional[ClassNode]:
+        if cls is None or depth > _MAX_MRO_DEPTH:
+            return None
+        raw = cls.attr_types.get(attr)
+        if raw:
+            return self._class_from_raw(cls.module, raw)
+        for braw in cls.bases:
+            k = self._attr_class(
+                self._class_from_raw(cls.module, braw, depth + 1), attr,
+                depth + 1)
+            if k is not None:
+                return k
+        return None
+
+    def resolve(self, module: str, scope: str, raw: str,
+                fn: Optional[FunctionNode] = None) -> Optional[str]:
+        """Qual of the project function ``raw`` calls from ``scope``, or
+        None when the target is dynamic or outside the project."""
+        if not raw:
+            return None
+        parts = raw.split(".")
+        imp = self.imports.get(module, {})
+        if parts[0] in ("self", "cls"):
+            owner = self._owning_class(module, scope)
+            if owner is None:
+                return None
+            if len(parts) == 2:
+                return self._method(owner, parts[1])
+            if len(parts) == 3:
+                return self._method(self._attr_class(owner, parts[1]),
+                                    parts[2])
+            return None
+        if len(parts) == 1:
+            name = parts[0]
+            if scope != "<module>":
+                nested = self.functions.get(f"{module}:{scope}.{name}")
+                if nested is not None:
+                    return nested.qual
+            q = f"{module}:{name}"
+            if q in self.functions:
+                return q
+            if q in self.classes:
+                return self._method(self.classes[q], "__init__")
+            target = imp.get(name)
+            if target:
+                return self._resolve_target(target)
+            return None
+        # var.meth() through a function-local constructor assignment
+        if fn is not None and parts[0] in fn.local_types and len(parts) == 2:
+            k = self._class_from_raw(module, fn.local_types[parts[0]])
+            if k is not None:
+                m = self._method(k, parts[1])
+                if m:
+                    return m
+        target = imp.get(parts[0])
+        if target:
+            if target in self.modules:
+                q = f"{target}:{'.'.join(parts[1:])}"
+                if q in self.functions:
+                    return q
+                if len(parts) == 2 and q in self.classes:
+                    return self._method(self.classes[q], "__init__")
+                if len(parts) == 3:
+                    return self._method(
+                        self.classes.get(f"{target}:{parts[1]}"), parts[2])
+            else:
+                head, _, tail = target.rpartition(".")
+                cn = self.classes.get(f"{head}:{tail}")
+                if cn is not None and len(parts) == 2:
+                    return self._method(cn, parts[1])
+            return None
+        # fully-dotted module path: storm_tpu.dist.wire.encode(...)
+        mod_guess = ".".join(parts[:-1])
+        if mod_guess in self.modules:
+            q = f"{mod_guess}:{parts[-1]}"
+            if q in self.functions:
+                return q
+        return None
+
+    def _resolve_target(self, target: str) -> Optional[str]:
+        head, _, tail = target.rpartition(".")
+        if head in self.modules:
+            q = f"{head}:{tail}"
+            if q in self.functions:
+                return q
+            if q in self.classes:
+                return self._method(self.classes[q], "__init__")
+        return None
+
+    def _resolve_all(self) -> None:
+        for fn in self.functions.values():
+            seen: Set[str] = set()
+            for rec in fn.calls:
+                q = self.resolve(fn.module, fn.scope, rec.raw, fn)
+                if q and q != fn.qual and q not in seen:
+                    seen.add(q)
+                    fn.resolved.append(q)
+                    fn.call_raw[q] = rec.raw
+
+    # -- summaries --------------------------------------------------------
+
+    def _summarize(self) -> None:
+        rev: Dict[str, List[str]] = defaultdict(list)
+        for q, fn in self.functions.items():
+            for c in fn.resolved:
+                rev[c].append(q)
+        dist: Dict[str, int] = {}
+        queue: deque = deque()
+        for q in sorted(self.functions):
+            fn = self.functions[q]
+            if fn.blocking:
+                fn.blocking.sort(key=lambda t: t[1])
+                fn.may_block = True
+                fn.block_reason = fn.blocking[0][0]
+                dist[q] = 0
+                queue.append(q)
+        while queue:
+            q = queue.popleft()
+            for caller in sorted(rev[q]):
+                if caller in dist:
+                    continue
+                dist[caller] = dist[q] + 1
+                cf = self.functions[caller]
+                cf.may_block = True
+                cf.block_via = q
+                queue.append(caller)
+        # transitive lock acquisition closure
+        for fn in self.functions.values():
+            fn.trans_acquires = set(fn.acquires)
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions.values():
+                before = len(fn.trans_acquires)
+                for c in fn.resolved:
+                    fn.trans_acquires |= self.functions[c].trans_acquires
+                if len(fn.trans_acquires) != before:
+                    changed = True
+
+    def short(self, qual: str) -> str:
+        module, _, scope = qual.partition(":")
+        return f"{module.rsplit('.', 1)[-1]}.{scope}"
+
+    def block_chain(self, qual: str) -> List[str]:
+        """Shortest witness chain from ``qual`` down to the concrete
+        blocking call, e.g. ``['controller.DistCluster.recover_worker',
+        'controller.DistCluster._reship', 'client.control']``."""
+        out: List[str] = []
+        q: Optional[str] = qual
+        for _ in range(64):
+            if q is None or q not in self.functions:
+                break
+            fn = self.functions[q]
+            out.append(self.short(q))
+            if fn.block_via is None:
+                out.append(fn.block_reason or "?")
+                break
+            q = fn.block_via
+        return out
+
+    def lifecycle_reachable(self) -> Set[str]:
+        """Functions reachable (forward) from a lifecycle-named entry point
+        or from module level — the set a thread's ``join()`` site must live
+        in for the thread to be reaped on shutdown (THR001)."""
+        if self._lifecycle_reach is not None:
+            return self._lifecycle_reach
+        roots = [q for q, fn in self.functions.items()
+                 if fn.scope == "<module>" or _LIFECYCLE.search(fn.name)]
+        seen: Set[str] = set(roots)
+        stack = list(roots)
+        while stack:
+            q = stack.pop()
+            for c in self.functions[q].resolved:
+                if c not in seen:
+                    seen.add(c)
+                    stack.append(c)
+        self._lifecycle_reach = seen
+        return seen

@@ -29,6 +29,13 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 RULES: Dict[str, str] = {
     "LCK001": "blocking call while a lock is held",
     "LCK002": "lock-order inversion between acquisition sites",
+    "LCK003": "call that may block transitively while a lock is held",
+    "LCK004": "lock-order cycle in the cross-file acquisition graph",
+    "THR001": "thread without daemon flag, finalizer, or shutdown join",
+    "THR002": "executor without shutdown or ownership hand-off",
+    "PRT001": "control command sent/handled on only one side of the wire",
+    "PRT002": "journal kind emitted without an apply fold arm",
+    "PRT003": "flight event not in the generated protocol registry",
     "XO001": "tuple can leave execute() without ack/fail/deferral",
     "JIT001": "np.* applied to a traced argument inside jit",
     "JIT002": "Python control flow branches on a tracer value",
@@ -51,6 +58,9 @@ class Finding:
     #: Stable detail token for baseline keying (e.g. the offending call
     #: text) — survives line drift from unrelated edits.
     detail: str = ""
+    #: Witness chain for interprocedural findings (LCK003's call chain
+    #: down to the blocking call, LCK004's lock cycle); empty otherwise.
+    chain: List[str] = field(default_factory=list)
 
     def key(self) -> str:
         return f"{self.rule}:{self.path}:{self.scope}:{self.detail}"
@@ -65,6 +75,7 @@ class Finding:
             "message": self.message,
             "hint": self.hint,
             "key": self.key(),
+            "chain": list(self.chain),
         }
 
     def render(self) -> str:
@@ -373,29 +384,67 @@ def _check_file(sf: SourceFile, config: LintConfig) -> List[Finding]:
     return out
 
 
-def cross_file_findings(files: Sequence[SourceFile],
-                        config: LintConfig) -> List[Finding]:
-    """Whole-tree passes that need every file at once: the lock-order
-    inversion graph (LCK002) and metric kind conflicts (OBS003)."""
-    from storm_tpu.analysis import locks, observability
+def cross_file_findings(files: Sequence[SourceFile], config: LintConfig,
+                        timings: Optional[Dict[str, float]] = None
+                        ) -> List[Finding]:
+    """Whole-tree passes that need every file at once: the call graph and
+    the interprocedural rules built on it (LCK002-004, THR, PRT), plus
+    metric kind conflicts (OBS003). ``timings`` (from ``--profile``) is
+    filled with per-phase wall-clock seconds."""
+    import time as _time
 
+    from storm_tpu.analysis import (
+        callgraph,
+        locks,
+        observability,
+        protocol,
+        threads,
+    )
+
+    t0 = _time.perf_counter()
+    graph = callgraph.CallGraph(files, config)
+    if timings is not None:
+        timings["callgraph_s"] = _time.perf_counter() - t0
+    passes = (
+        ("lck002_s", lambda: locks.check_ordering(
+            files, config, edges_in=graph.lock_edges)),
+        ("lck003_s", lambda: locks.check_transitive(graph, config)),
+        ("lck004_s", lambda: locks.check_cycles(graph, config)),
+        ("thr_s", lambda: threads.check_lifecycles(files, config, graph)),
+        ("prt_s", lambda: protocol.check_protocols(files, config)),
+        ("obs003_s", lambda: observability.check_kinds(files, config)),
+    )
     out: List[Finding] = []
-    for f in locks.check_ordering(files, config):
-        if config.rule_enabled(f.rule) and not config.excluded(f.rule, f.path):
-            out.append(f)
-    for f in observability.check_kinds(files, config):
-        if config.rule_enabled(f.rule) and not config.excluded(f.rule, f.path):
-            out.append(f)
+    for label, run in passes:
+        t0 = _time.perf_counter()
+        for f in run():
+            if config.rule_enabled(f.rule) and not config.excluded(
+                    f.rule, f.path):
+                out.append(f)
+        if timings is not None:
+            timings[label] = _time.perf_counter() - t0
     return out
 
 
 def run_lint(paths: Sequence[str], root: str,
-             config: Optional[LintConfig] = None) -> List[Finding]:
+             config: Optional[LintConfig] = None,
+             timings: Optional[Dict[str, float]] = None) -> List[Finding]:
     """Full run: per-file checkers plus the cross-file graph passes."""
+    import time as _time
+
+    t_start = _time.perf_counter()
     config = config or load_config(root)
     files, findings = _load_files(paths, root)
+    if timings is not None:
+        timings["load_s"] = _time.perf_counter() - t_start
+        timings["files"] = len(files)
+    t0 = _time.perf_counter()
     for sf in files:
         findings.extend(_check_file(sf, config))
-    findings.extend(cross_file_findings(files, config))
+    if timings is not None:
+        timings["per_file_s"] = _time.perf_counter() - t0
+    findings.extend(cross_file_findings(files, config, timings))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if timings is not None:
+        timings["total_s"] = _time.perf_counter() - t_start
     return findings

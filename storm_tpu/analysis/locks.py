@@ -29,7 +29,15 @@ justification, not in code-level suppressions.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import (
+    Dict,
+    Iterable,
+    List,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 from storm_tpu.analysis.core import (
     Finding,
@@ -60,6 +68,10 @@ BLOCKING_METHODS = {
 #: ``.get()`` / ``.put(...)`` mean the blocking queue protocol, not dict.get)
 _QUEUEISH = ("queue", "inbox", "outbox", "mailbox")
 
+#: schedulers that take a coroutine *object*: ``create_task(proc.wait())``
+#: queues the wrapped call for later, so it never blocks at this site
+_SCHEDULERS = ("create_task", "ensure_future", "run_coroutine_threadsafe")
+
 
 def _module_of(path: str) -> str:
     mod = path[:-3] if path.endswith(".py") else path
@@ -88,6 +100,24 @@ class _Region:
         self.line = line
 
 
+class CallRecord(NamedTuple):
+    """Every call the walker sees, with its lock context — the substrate
+    the interprocedural passes (analysis/callgraph.py) are built on.
+
+    ``reason`` is the LCK001 blocking reason (held-aware: Condition.wait
+    on a held lock is exempt); ``summary_reason`` ignores that exemption,
+    because a callee that parks on its own condition still sleeps while
+    the *caller's* locks stay held — that is exactly what a transitive
+    blocking summary must propagate."""
+
+    scope: str
+    raw: str  # dotted callee text ('' for dynamic calls)
+    line: int
+    held: Tuple[str, ...]  # lock keys held at the call site, outer->inner
+    reason: Optional[str]
+    summary_reason: Optional[str]
+
+
 class _LockWalker:
     """Per-file walk producing LCK001 findings and acquisition edges."""
 
@@ -98,6 +128,10 @@ class _LockWalker:
         self.findings: List[Finding] = []
         #: (outer_key, inner_key, path, line, scope)
         self.edges: List[Tuple[str, str, str, int, str]] = []
+        #: every call with its lock context (callgraph substrate)
+        self.calls: List[CallRecord] = []
+        #: every lock acquisition: (scope, key, line)
+        self.acquisitions: List[Tuple[str, str, int]] = []
         self._class_stack: List[str] = []
         self._func_stack: List[str] = []
 
@@ -165,6 +199,7 @@ class _LockWalker:
         return None
 
     def _enter(self, key: str, line: int, held: List[_Region]) -> None:
+        self.acquisitions.append((self.scope, key, line))
         for outer in held:
             if outer.key != key:
                 self.edges.append(
@@ -220,17 +255,27 @@ class _LockWalker:
     # -- blocking-call detection ------------------------------------------
 
     def _scan_expr(self, node: ast.AST, held: List[_Region]) -> None:
-        if not held:
-            return
+        scheduled = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and last_segment(dotted_name(sub.func)) in _SCHEDULERS:
+                for a in sub.args:
+                    if isinstance(a, ast.Call):
+                        scheduled.add(a)
         for sub in ast.walk(node):
             if isinstance(sub, ast.Lambda):
                 continue  # runs later
-            if isinstance(sub, ast.Call):
+            if isinstance(sub, ast.Call) and sub not in scheduled:
                 self._check_call(sub, held)
 
     def _check_call(self, call: ast.Call, held: List[_Region]) -> None:
-        reason = self._blocking_reason(call, held)
-        if reason is None:
+        summary = self._blocking_reason(call, [])
+        reason = self._blocking_reason(call, held) if summary else None
+        self.calls.append(CallRecord(
+            scope=self.scope, raw=dotted_name(call.func), line=call.lineno,
+            held=tuple(r.key for r in held),
+            reason=reason if held else None, summary_reason=summary))
+        if not held or reason is None:
             return
         innermost = held[-1]
         self.findings.append(Finding(
@@ -313,13 +358,18 @@ def collect_edges(sf: SourceFile, config: LintConfig):
     return w.edges
 
 
-def check_ordering(files: Iterable[SourceFile],
-                   config: LintConfig) -> List[Finding]:
-    """LCK002: find 2-cycles in the whole-tree lock-acquisition graph."""
+def check_ordering(files: Iterable[SourceFile], config: LintConfig,
+                   edges_in: Optional[Sequence[Tuple[str, str, str, int, str]]]
+                   = None) -> List[Finding]:
+    """LCK002: find 2-cycles in the whole-tree lock-acquisition graph.
+
+    ``edges_in`` lets the driver reuse the walker output already collected
+    for the call graph instead of re-walking every file."""
     edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
-    for sf in files:
-        for outer, inner, path, line, scope in collect_edges(sf, config):
-            edges.setdefault((outer, inner), (path, line, scope))
+    if edges_in is None:
+        edges_in = [e for sf in files for e in collect_edges(sf, config)]
+    for outer, inner, path, line, scope in edges_in:
+        edges.setdefault((outer, inner), (path, line, scope))
     findings: List[Finding] = []
     seen = set()
     for (a, b), (path, line, scope) in sorted(edges.items()):
@@ -340,5 +390,130 @@ def check_ordering(files: Iterable[SourceFile],
                   "sites follow it, or split the critical sections so "
                   "neither nests"),
             detail="<->".join(sorted((a, b))),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural tier (LCK003/LCK004) — built on analysis/callgraph.py
+# ---------------------------------------------------------------------------
+
+
+def check_transitive(graph, config: LintConfig) -> List[Finding]:
+    """LCK003: a call under a held lock whose *callee* may block, any
+    number of frames down — the depth-N upgrade of LCK001. Direct blocking
+    calls are LCK001's job and are skipped here; the finding prints the
+    shortest witness chain down to the concrete blocking call."""
+    findings: List[Finding] = []
+    emitted = set()
+    for lc in graph.locked_calls:
+        if lc.reason is not None:
+            continue  # directly blocking: LCK001 already covers it
+        caller_q = f"{lc.module}:{lc.scope}"
+        target = graph.resolve(lc.module, lc.scope, lc.raw,
+                               graph.functions.get(caller_q))
+        if target is None or target == caller_q:
+            continue
+        fn = graph.functions[target]
+        if not fn.may_block:
+            continue
+        chain = graph.block_chain(target)
+        detail = f"{lc.raw}->{chain[-1]}"
+        dkey = (lc.path, lc.scope, detail)
+        if dkey in emitted:
+            continue
+        emitted.add(dkey)
+        innermost = lc.held[-1]
+        findings.append(Finding(
+            rule="LCK003",
+            path=lc.path,
+            line=lc.line,
+            scope=lc.scope,
+            message=(f"{lc.raw}() may block while holding "
+                     f"{innermost.split(':')[-1]}: "
+                     f"{' -> '.join(chain)}"),
+            hint=("the callee (or something it calls) blocks; snapshot "
+                  "under the lock and call after releasing, or baseline "
+                  "with a justification if the hold is intentional"),
+            detail=detail,
+            chain=chain,
+        ))
+    return findings
+
+
+_MAX_CYCLE_LEN = 6
+_MAX_CYCLES = 64
+
+
+def check_cycles(graph, config: LintConfig) -> List[Finding]:
+    """LCK004: full lock-order cycle detection over the acquisition graph
+    (SCC-style bounded DFS), replacing LCK002's 2-cycle special case for
+    anything longer — and extending the edge set *interprocedurally*: a
+    call made while holding A into a function whose lock summary says it
+    may take B contributes an A->B edge even though no single function
+    nests the two acquisitions. Syntactic 2-cycles stay LCK002's report."""
+    # edge -> (path, line, scope, how)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str, str]] = {}
+    syntactic = set()
+    for outer, inner, path, line, scope in graph.lock_edges:
+        if outer == inner:
+            continue
+        edges.setdefault((outer, inner), (path, line, scope, "nested here"))
+        syntactic.add((outer, inner))
+    for lc in graph.locked_calls:
+        caller_q = f"{lc.module}:{lc.scope}"
+        target = graph.resolve(lc.module, lc.scope, lc.raw,
+                               graph.functions.get(caller_q))
+        if target is None:
+            continue
+        for dest in graph.functions[target].trans_acquires:
+            for held in lc.held:
+                if held != dest:
+                    edges.setdefault(
+                        (held, dest),
+                        (lc.path, lc.line, lc.scope, f"via {lc.raw}()"))
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    for a in adj:
+        adj[a].sort()
+    cycles: List[Tuple[str, ...]] = []
+
+    def _dfs(start: str, node: str, path: List[str],
+             on_path: set) -> None:
+        if len(cycles) >= _MAX_CYCLES or len(path) > _MAX_CYCLE_LEN:
+            return
+        for nxt in adj.get(node, ()):
+            if nxt < start:
+                continue  # each cycle enumerated from its min node only
+            if nxt == start:
+                if len(path) >= 2:
+                    cycles.append(tuple(path))
+            elif nxt not in on_path:
+                on_path.add(nxt)
+                _dfs(start, nxt, path + [nxt], on_path)
+                on_path.discard(nxt)
+
+    for start in sorted(adj):
+        _dfs(start, start, [start], {start})
+    findings: List[Finding] = []
+    for cyc in sorted(cycles):
+        pairs = [(cyc[i], cyc[(i + 1) % len(cyc)]) for i in range(len(cyc))]
+        if len(cyc) == 2 and all(p in syntactic for p in pairs):
+            continue  # LCK002 reports syntactic 2-cycles
+        path, line, scope, how = edges[pairs[0]]
+        shorts = [k.split(":")[-1] for k in cyc]
+        findings.append(Finding(
+            rule="LCK004",
+            path=path,
+            line=line,
+            scope=scope,
+            message=(f"lock-order cycle {' -> '.join(shorts)} -> "
+                     f"{shorts[0]} (first edge {how})"),
+            hint=("impose one global acquisition order over these locks, "
+                  "or break the chain by moving a call out of the held "
+                  "region"),
+            detail="->".join(cyc),
+            chain=list(cyc),
         ))
     return findings

@@ -1,0 +1,397 @@
+"""PRT001-003: control-plane protocol conformance.
+
+Three of the mesh's wire surfaces are bare string protocols, exactly like
+the metric names were before the generated registry caught two real gaps:
+
+* control commands — ``client.control("drain", ...)`` on the controller
+  side vs the ``cmd == "drain"`` chain in ``dist/worker.py:_control``;
+* journal record kinds — ``self._jappend("rebalance", ...)`` vs the
+  ``kind == "rebalance"`` fold arms in ``ControlPlaneState.apply``
+  (unknown-kind *replay* is deliberately a no-op for forward
+  compatibility, but *emitting* a kind nothing folds is lost state);
+* flight-recorder event names — ``flight.event("dist_circuit_open", ...)``
+  read back by dashboards, the fleet scorecard, and chaos drills.
+
+A typo on either side of any of these doesn't error; it silently drops
+the command, the journal record, or the dashboard row. So:
+
+* **PRT001** — every control command sent must have a handler, and every
+  handler must have an in-tree sender (externally-driven commands are
+  baselined with a why). When the linted file set lacks the handler (or
+  sender) side, the generated registry stands in for it.
+* **PRT002** — every journal kind emitted must have an ``apply`` fold arm.
+* **PRT003** — every literal flight-event name must be in the generated
+  registry (``storm_tpu/analysis/protocol_names.py``) and carry that
+  event's required fields (the fields every registered site provides);
+  f-string names must match a registered wildcard pattern. The registry is
+  generated from the call sites (``storm-tpu lint
+  --regen-protocol-registry``) and freshness-gated in tier-1, same as
+  ``metric_names.py``; ``runtime/tracing.py`` warns once at runtime for
+  dynamic names the AST pass can't see.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from storm_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    ScopedVisitor,
+    SourceFile,
+    dotted_name,
+    last_segment,
+)
+from storm_tpu.analysis.observability import (
+    _STRICT_PATTERN_MIN_LITERAL,
+    _pattern_of,
+)
+
+_REGISTRY_PATH = "storm_tpu/analysis/protocol_names.py"
+
+#: (name, path, line, scope)
+Site = Tuple[str, str, int, str]
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Collection
+# ---------------------------------------------------------------------------
+
+
+def collect_control(files: Iterable[SourceFile]
+                    ) -> Tuple[Dict[str, List[Site]], Dict[str, List[Site]]]:
+    """(sent, handled): literal commands passed to ``.control()``/
+    ``.probe()`` vs literal ``cmd == "..."`` arms inside ``_control``."""
+    sent: Dict[str, List[Site]] = {}
+    handled: Dict[str, List[Site]] = {}
+    for sf in files:
+        if sf.path == _REGISTRY_PATH:
+            continue
+
+        class V(ScopedVisitor):
+            def visit_Call(self, call: ast.Call) -> None:
+                if isinstance(call.func, ast.Attribute) \
+                        and call.func.attr in ("control", "probe") \
+                        and call.args:
+                    cmd = _const_str(call.args[0])
+                    if cmd is not None:
+                        sent.setdefault(cmd, []).append(
+                            (cmd, sf.path, call.lineno, self.scope))
+                self.generic_visit(call)
+
+            def visit_Compare(self, node: ast.Compare) -> None:
+                if "_control" in self.scope.split(".") \
+                        and isinstance(node.left, ast.Name) \
+                        and node.left.id == "cmd" \
+                        and len(node.ops) == 1:
+                    if isinstance(node.ops[0], ast.Eq):
+                        cmd = _const_str(node.comparators[0])
+                        if cmd is not None:
+                            handled.setdefault(cmd, []).append(
+                                (cmd, sf.path, node.lineno, self.scope))
+                    elif isinstance(node.ops[0], ast.In) and isinstance(
+                            node.comparators[0], (ast.Tuple, ast.List,
+                                                  ast.Set)):
+                        for el in node.comparators[0].elts:
+                            cmd = _const_str(el)
+                            if cmd is not None:
+                                handled.setdefault(cmd, []).append(
+                                    (cmd, sf.path, node.lineno, self.scope))
+                self.generic_visit(node)
+
+        V().visit(sf.tree)
+    return sent, handled
+
+
+def collect_journal(files: Iterable[SourceFile]
+                    ) -> Tuple[Dict[str, List[Site]], Dict[str, List[Site]]]:
+    """(emitted, folded): kinds appended to the controller journal vs the
+    ``kind == "..."`` fold arms inside an ``apply`` function."""
+    emitted: Dict[str, List[Site]] = {}
+    folded: Dict[str, List[Site]] = {}
+    for sf in files:
+        if sf.path == _REGISTRY_PATH:
+            continue
+
+        class V(ScopedVisitor):
+            def visit_Call(self, call: ast.Call) -> None:
+                if isinstance(call.func, ast.Attribute) and call.args:
+                    attr = call.func.attr
+                    base = last_segment(dotted_name(call.func.value)).lower()
+                    if attr == "_jappend" or (
+                            attr == "append" and "journal" in base):
+                        kind = _const_str(call.args[0])
+                        if kind is not None:
+                            emitted.setdefault(kind, []).append(
+                                (kind, sf.path, call.lineno, self.scope))
+                self.generic_visit(call)
+
+            def visit_Compare(self, node: ast.Compare) -> None:
+                if "apply" in self.scope.split(".") \
+                        and isinstance(node.left, ast.Name) \
+                        and node.left.id == "kind" \
+                        and len(node.ops) == 1 \
+                        and isinstance(node.ops[0], ast.Eq):
+                    kind = _const_str(node.comparators[0])
+                    if kind is not None:
+                        folded.setdefault(kind, []).append(
+                            (kind, sf.path, node.lineno, self.scope))
+                self.generic_visit(node)
+
+        V().visit(sf.tree)
+    return emitted, folded
+
+
+def _flightish(base: str) -> bool:
+    seg = last_segment(base).lower().lstrip("_")
+    return "flight" in seg or seg in ("fl", "recorder")
+
+
+#: (name_or_pattern, is_pattern, fields-or-None, path, line, scope)
+FlightSite = Tuple[str, bool, Optional[frozenset], str, int, str]
+
+
+def collect_flight(files: Iterable[SourceFile]) -> List[FlightSite]:
+    sites: List[FlightSite] = []
+    for sf in files:
+        if sf.path == _REGISTRY_PATH:
+            continue
+
+        class V(ScopedVisitor):
+            def visit_Call(self, call: ast.Call) -> None:
+                if isinstance(call.func, ast.Attribute) \
+                        and call.func.attr == "event" and call.args \
+                        and _flightish(dotted_name(call.func.value)):
+                    fields: Optional[frozenset] = frozenset(
+                        k.arg for k in call.keywords
+                        if k.arg not in (None, "throttle_s"))
+                    if any(k.arg is None for k in call.keywords):
+                        fields = None  # **kwargs: field set unknowable
+                    arg = call.args[0]
+                    name = _const_str(arg)
+                    if name is not None:
+                        sites.append((name, False, fields, sf.path,
+                                      call.lineno, self.scope))
+                    elif isinstance(arg, ast.JoinedStr):
+                        sites.append((_pattern_of(arg), True, fields,
+                                      sf.path, call.lineno, self.scope))
+                self.generic_visit(call)
+
+        V().visit(sf.tree)
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# Checks
+# ---------------------------------------------------------------------------
+
+
+def _registry():
+    try:
+        from storm_tpu.analysis import protocol_names
+        return protocol_names
+    except ImportError:  # not generated yet: registry-backed checks inert
+        return None
+
+
+def _first(sites: List[Site]) -> Site:
+    return sorted(sites, key=lambda s: (s[1], s[3], s[2]))[0]
+
+
+def check_protocols(files: Sequence[SourceFile],
+                    config: LintConfig) -> List[Finding]:
+    reg = _registry()
+    findings: List[Finding] = []
+    findings.extend(_check_control(files, reg))
+    findings.extend(_check_journal(files, reg))
+    findings.extend(_check_flight(files, reg))
+    return findings
+
+
+def _check_control(files: Sequence[SourceFile], reg) -> List[Finding]:
+    sent, handled = collect_control(files)
+    out: List[Finding] = []
+    handled_names: Set[str] = set(handled)
+    if not handled_names and reg is not None:
+        handled_names = set(getattr(reg, "CONTROL_COMMANDS", ()))
+    sent_names: Set[str] = set(sent)
+    if not sent_names and reg is not None:
+        sent_names = set(getattr(reg, "CONTROL_SENT", ()))
+    if handled_names:
+        for cmd in sorted(set(sent) - handled_names):
+            _name, path, line, scope = _first(sent[cmd])
+            out.append(Finding(
+                rule="PRT001", path=path, line=line, scope=scope,
+                message=(f"control command {cmd!r} is sent here but no "
+                         "worker `cmd ==` arm handles it"),
+                hint=("typo, or add the handler to dist/worker.py "
+                      "_control (the worker raises `unknown control cmd` "
+                      "at runtime)"),
+                detail=f"unhandled:{cmd}"))
+    if sent_names:
+        for cmd in sorted(set(handled) - sent_names):
+            _name, path, line, scope = _first(handled[cmd])
+            out.append(Finding(
+                rule="PRT001", path=path, line=line, scope=scope,
+                message=(f"control command {cmd!r} has a handler but "
+                         "nothing in the tree sends it"),
+                hint=("dead protocol arm, or an externally-driven command "
+                      "(bench/ops tooling) — baseline those with a why"),
+                detail=f"unsent:{cmd}"))
+    return out
+
+
+def _check_journal(files: Sequence[SourceFile], reg) -> List[Finding]:
+    emitted, folded = collect_journal(files)
+    folded_names: Set[str] = set(folded)
+    if not folded_names and reg is not None:
+        folded_names = set(getattr(reg, "JOURNAL_KINDS", ()))
+    out: List[Finding] = []
+    if not folded_names:
+        return out
+    for kind in sorted(set(emitted) - folded_names):
+        _name, path, line, scope = _first(emitted[kind])
+        out.append(Finding(
+            rule="PRT002", path=path, line=line, scope=scope,
+            message=(f"journal kind {kind!r} is appended here but "
+                     "ControlPlaneState.apply has no fold arm for it — "
+                     "replay silently drops it"),
+            hint=("add the `kind == ...` arm to dist/journal.py apply() "
+                  "(unknown-kind replay staying a no-op is the forward-"
+                  "compat contract for *old* binaries, not new emitters)"),
+            detail=f"unfolded:{kind}"))
+    return out
+
+
+def _check_flight(files: Sequence[SourceFile], reg) -> List[Finding]:
+    out: List[Finding] = []
+    if reg is None:
+        return out
+    known: Dict[str, tuple] = dict(getattr(reg, "FLIGHT_EVENTS", {}))
+    patterns: Sequence[str] = tuple(getattr(reg, "FLIGHT_EVENT_PATTERNS", ()))
+    strict = [p for p in patterns
+              if len(p.replace("*", "")) >= _STRICT_PATTERN_MIN_LITERAL]
+    for name, is_pattern, fields, path, line, scope in collect_flight(files):
+        if is_pattern:
+            if name not in patterns:
+                out.append(Finding(
+                    rule="PRT003", path=path, line=line, scope=scope,
+                    message=(f"flight event pattern {name!r} is not in the "
+                             "generated protocol registry"),
+                    hint=("run `storm-tpu lint --regen-protocol-registry` "
+                          "and commit protocol_names.py with the change"),
+                    detail=f"event:{name}"))
+            continue
+        if name not in known:
+            if any(fnmatch.fnmatchcase(name, p) for p in strict):
+                continue
+            out.append(Finding(
+                rule="PRT003", path=path, line=line, scope=scope,
+                message=(f"flight event {name!r} is not in the generated "
+                         "protocol registry"),
+                hint=("typo? fix the name; new event? run `storm-tpu lint "
+                      "--regen-protocol-registry` and commit "
+                      "protocol_names.py"),
+                detail=f"event:{name}"))
+            continue
+        if fields is not None:
+            missing = sorted(set(known[name]) - fields)
+            if missing:
+                out.append(Finding(
+                    rule="PRT003", path=path, line=line, scope=scope,
+                    message=(f"flight event {name!r} omits required "
+                             f"field(s) {', '.join(missing)} that every "
+                             "registered site provides"),
+                    hint=("readers key on those fields; pass them, or "
+                          "regen the registry if the contract changed"),
+                    detail=f"fields:{name}:{','.join(missing)}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Registry generation
+# ---------------------------------------------------------------------------
+
+_HEADER = '''"""Control-plane protocol registry — GENERATED, do not edit by hand.
+
+Regenerate after adding a control command, journal kind, or flight event:
+
+    storm-tpu lint --regen-protocol-registry
+
+Generated from the tree's own call sites: ``.control()``/``.probe()``
+sends and ``cmd ==`` handler arms, journal ``_jappend``/fold arms, and
+every literal ``flight.event(...)`` name with the fields common to all of
+its sites. ``storm_tpu/analysis/protocol.py`` (PRT001-003) checks call
+sites against this file statically; ``runtime/tracing.py`` warns once at
+runtime for event names built from variables — together they catch the
+drift whose only other symptom is a command that bounces, a journal record
+replay silently drops, or a dashboard row that never appears.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+'''
+
+
+def generate_registry(files: Sequence[SourceFile]) -> str:
+    sent, handled = collect_control(files)
+    emitted, folded = collect_journal(files)
+    flight = collect_flight(files)
+    names: Dict[str, Optional[Set[str]]] = {}
+    patterns: Set[str] = set()
+    for name, is_pattern, fields, _path, _line, _scope in flight:
+        if is_pattern:
+            patterns.add(name)
+            continue
+        if name not in names:
+            names[name] = None if fields is None else set(fields)
+        elif fields is not None:
+            cur = names[name]
+            names[name] = set(fields) if cur is None else (cur & fields)
+    lines = [_HEADER]
+
+    def _emit_set(title: str, var: str, values: Iterable[str]) -> None:
+        lines.append(f"#: {title}")
+        lines.append(f"{var} = frozenset({{")
+        for v in sorted(values):
+            lines.append(f"    {v!r},")
+        lines.append("})")
+        lines.append("")
+
+    _emit_set("commands with a `cmd ==` handler arm (dist/worker.py)",
+              "CONTROL_COMMANDS", handled)
+    _emit_set("commands sent via .control()/.probe() in the tree",
+              "CONTROL_SENT", sent)
+    _emit_set("journal kinds with an apply() fold arm (dist/journal.py)",
+              "JOURNAL_KINDS", folded)
+    _emit_set("journal kinds appended in the tree", "JOURNAL_EMITTED",
+              emitted)
+    lines.append("#: literal flight-event name -> fields every site provides")
+    lines.append("FLIGHT_EVENTS = {")
+    for n in sorted(names):
+        req = tuple(sorted(names[n] or ()))
+        lines.append(f"    {n!r}: {req!r},")
+    lines.append("}")
+    lines.append("")
+    lines.append("FLIGHT_EVENT_PATTERNS = (")
+    for p in sorted(patterns):
+        lines.append(f"    {p!r},")
+    lines.append(")")
+    lines.append("")
+    lines.append("")
+    lines.append("def is_known_event(name: str) -> bool:")
+    lines.append("    if name in FLIGHT_EVENTS:")
+    lines.append("        return True")
+    lines.append("    return any(fnmatch.fnmatchcase(name, p)")
+    lines.append("               for p in FLIGHT_EVENT_PATTERNS)")
+    lines.append("")
+    return "\n".join(lines)

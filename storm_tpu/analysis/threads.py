@@ -1,0 +1,284 @@
+"""THR001/THR002: thread and executor lifecycle discipline.
+
+The runtime's thread population keeps growing (engine fetch thread,
+continuous-batching dispatcher, observatory loop, dist heartbeat monitor,
+peer senders, chaos drivers, profile capture), and a single non-daemon
+thread with no join path turns every clean shutdown into a hang — the
+interpreter waits on it forever, which in a worker process means the
+controller's drain times out and the restart escalates to SIGKILL.
+
+* **THR001** — every ``threading.Thread`` created in the tree must be
+  ``daemon=True``, handed to ``weakref.finalize``, or *joined from a
+  lifecycle path*: the ``join()`` site's function must be reachable (via
+  the project call graph) from a ``close``/``shutdown``/``stop``-style
+  entry point or module level. A join buried in a helper nobody calls on
+  shutdown is still a leak.
+* **THR002** — every ``ThreadPoolExecutor``/``ProcessPoolExecutor`` must
+  be context-managed, have ``.shutdown()`` called on it in the owning
+  scope, or be handed off whole as an argument (``grpc.server(pool)``
+  transfers ownership to the server).
+
+Both checks are deliberately alias-aware but shallow: ``t = self._thread``
+then ``t.join()`` counts, ``for t in self._threads: t.join()`` counts;
+anything more dynamic should either be daemonized or baselined with a why.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from storm_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    SourceFile,
+    dotted_name,
+    last_segment,
+)
+from storm_tpu.analysis.callgraph import _LIFECYCLE, CallGraph, module_of
+
+_EXECUTORS = ("ThreadPoolExecutor", "ProcessPoolExecutor")
+
+
+def _is_thread_ctor(name: str) -> bool:
+    return name == "threading.Thread" or name == "Thread" \
+        or name.endswith(".Thread")
+
+
+def _is_executor_ctor(name: str) -> bool:
+    return last_segment(name) in _EXECUTORS
+
+
+def _parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    out: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for ch in ast.iter_child_nodes(node):
+            out[ch] = node
+    return out
+
+
+def _context(node: ast.AST, parents: Dict[ast.AST, ast.AST]
+             ) -> Tuple[str, Optional[ast.AST], Optional[ast.ClassDef]]:
+    """(scope string, enclosing function node, enclosing class node)."""
+    names: List[str] = []
+    func: Optional[ast.AST] = None
+    cls: Optional[ast.ClassDef] = None
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.append(cur.name)
+            if func is None:
+                func = cur
+        elif isinstance(cur, ast.ClassDef):
+            names.append(cur.name)
+            if cls is None:
+                cls = cur
+        cur = parents.get(cur)
+    return ".".join(reversed(names)) or "<module>", func, cls
+
+
+def _binding(call: ast.Call, parents: Dict[ast.AST, ast.AST]
+             ) -> Tuple[str, str]:
+    """How the constructed object is captured.
+
+    Returns one of ``("attr", name)`` for ``self.name = ...`` (or
+    ``self.name.append(...)``), ``("local", name)``, ``("handoff", text)``
+    when passed whole into another call, ``("with", "")`` for a context
+    manager, or ``("inline", "")`` for ``Thread(...).start()``-style
+    fire-and-forget."""
+    cur: ast.AST = call
+    parent = parents.get(cur)
+    while parent is not None:
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            tgt = parent.targets[0] if isinstance(parent, ast.Assign) \
+                else parent.target
+            if isinstance(tgt, ast.Name):
+                return "local", tgt.id
+            if isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                return "attr", tgt.attr
+            return "inline", ""
+        if isinstance(parent, ast.Call) and cur in parent.args:
+            func = parent.func
+            if isinstance(func, ast.Attribute) and func.attr == "append":
+                base = dotted_name(func.value)
+                if base.startswith("self."):
+                    return "attr", base[5:]
+                if base:
+                    return "local", base
+            return "handoff", dotted_name(func) or "?"
+        if isinstance(parent, ast.withitem):
+            return "with", ""
+        if isinstance(parent, ast.Attribute):
+            # Thread(...).start() — never bound anywhere
+            return "inline", ""
+        if isinstance(parent, ast.stmt):
+            return "inline", ""
+        cur = parent
+        parent = parents.get(cur)
+    return "inline", ""
+
+
+def _aliases(scope_node: ast.AST, root_expr: str) -> Set[str]:
+    """Names that alias ``root_expr`` (e.g. ``self._t`` or ``threads``)
+    via plain assignment or ``for v in <root>`` loops, to a fixed point."""
+    exprs = {root_expr}
+    names: Set[str] = set()
+    if "." not in root_expr:
+        names.add(root_expr)
+    for _ in range(3):
+        grew = False
+        for node in ast.walk(scope_node):
+            src = None
+            tgt = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                src = dotted_name(node.value)
+                tgt = node.targets[0].id
+            elif isinstance(node, (ast.For, ast.AsyncFor)) \
+                    and isinstance(node.target, ast.Name):
+                src = dotted_name(node.iter)
+                tgt = node.target.id
+            if src and tgt and src in exprs and tgt not in names:
+                names.add(tgt)
+                exprs.add(tgt)
+                grew = True
+        if not grew:
+            break
+    return names
+
+
+def _has_call_on(scope_node: ast.AST, attr: str, root_expr: str) -> \
+        Optional[ast.Call]:
+    """First ``<alias>.<attr>(...)`` call on the bound object in scope."""
+    names = _aliases(scope_node, root_expr)
+    for node in ast.walk(scope_node):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)\
+                and node.func.attr == attr:
+            base = dotted_name(node.func.value)
+            if base == root_expr or base in names:
+                return node
+    return None
+
+
+def _finalized(scope_node: ast.AST, root_expr: str) -> bool:
+    names = _aliases(scope_node, root_expr)
+    for node in ast.walk(scope_node):
+        if isinstance(node, ast.Call) \
+                and dotted_name(node.func).endswith("finalize"):
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                nm = dotted_name(arg)
+                if nm == root_expr or nm in names:
+                    return True
+    return False
+
+
+def _daemon_ok(call: ast.Call) -> Optional[bool]:
+    """True: daemon=True constant; False: absent or constant False;
+    None: daemon=<expr> (can't prove, give the benefit of the doubt)."""
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            if isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+            return None
+    return False
+
+
+def check_lifecycles(files: Iterable[SourceFile], config: LintConfig,
+                     graph: Optional[CallGraph] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        findings.extend(_check_file(sf, config, graph))
+    return findings
+
+
+def _check_file(sf: SourceFile, config: LintConfig,
+                graph: Optional[CallGraph]) -> List[Finding]:
+    parents = _parents(sf.tree)
+    module = module_of(sf.path)
+    out: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if _is_thread_ctor(name):
+            out.extend(_check_thread(sf, node, parents, module, graph))
+        elif _is_executor_ctor(name):
+            out.extend(_check_executor(sf, node, parents))
+    return out
+
+
+def _search_scope(sf: SourceFile, kind: str,
+                  func: Optional[ast.AST],
+                  cls: Optional[ast.ClassDef]) -> ast.AST:
+    if kind == "attr":
+        return cls if cls is not None else sf.tree
+    return func if func is not None else sf.tree
+
+
+def _check_thread(sf: SourceFile, call: ast.Call,
+                  parents: Dict[ast.AST, ast.AST], module: str,
+                  graph: Optional[CallGraph]) -> List[Finding]:
+    daemon = _daemon_ok(call)
+    if daemon is True or daemon is None:
+        return []
+    scope, func, cls = _context(call, parents)
+    kind, name = _binding(call, parents)
+    tag = f"self.{name}" if kind == "attr" else (name or "<inline>")
+    if kind == "handoff":
+        return []  # ownership transferred whole; the callee's problem
+    if kind != "inline":
+        where = _search_scope(sf, kind, func, cls)
+        root = f"self.{name}" if kind == "attr" else name
+        if _finalized(where, root):
+            return []
+        join = _has_call_on(where, "join", root)
+        if join is not None:
+            if kind == "local":
+                return []  # joined before the creating function returns
+            jscope, _jf, _jc = _context(join, parents)
+            if graph is None:
+                return []
+            jqual = f"{module}:{jscope}"
+            if jqual in graph.lifecycle_reachable():
+                return []
+            return [_thr001(sf, call, scope, tag,
+                            f"joined only in {jscope}(), which no "
+                            "close/shutdown/stop path reaches")]
+    return [_thr001(sf, call, scope, tag,
+                    "no daemon flag, no finalizer, and no join on any "
+                    "shutdown path")]
+
+
+def _thr001(sf: SourceFile, call: ast.Call, scope: str, tag: str,
+            why: str) -> Finding:
+    return Finding(
+        rule="THR001", path=sf.path, line=call.lineno, scope=scope,
+        message=f"non-daemon thread {tag} leaks: {why}",
+        hint=("pass daemon=True, register weakref.finalize, or join it "
+              "from close()/shutdown()/stop() so process exit cannot hang "
+              "on it"),
+        detail=f"thread:{tag}")
+
+
+def _check_executor(sf: SourceFile, call: ast.Call,
+                    parents: Dict[ast.AST, ast.AST]) -> List[Finding]:
+    scope, func, cls = _context(call, parents)
+    kind, name = _binding(call, parents)
+    if kind in ("handoff", "with"):
+        return []
+    tag = f"self.{name}" if kind == "attr" else (name or "<inline>")
+    if kind != "inline":
+        where = _search_scope(sf, kind, func, cls)
+        root = f"self.{name}" if kind == "attr" else name
+        if _has_call_on(where, "shutdown", root) is not None:
+            return []
+    return [Finding(
+        rule="THR002", path=sf.path, line=call.lineno, scope=scope,
+        message=(f"executor {tag} is never shut down (and not "
+                 "context-managed or handed off)"),
+        hint=("use `with ThreadPoolExecutor(...) as pool:`, call "
+              ".shutdown() from the owner's close path, or pass it whole "
+              "to the component that owns its lifecycle"),
+        detail=f"executor:{tag}")]

@@ -1857,9 +1857,21 @@ class KafkaWireBroker:
                 (topic, partition), threading.Lock())
         with plock:
             with self._pid_lock:
-                if self._producer is None:
-                    self._producer = self.client.init_producer_id()
-                pid, epoch = self._producer
+                producer = self._producer
+            if producer is None:
+                # Init OUTSIDE _pid_lock: the coordinator retry loop can
+                # sleep for seconds, and holding the broker-wide lock
+                # across it would stall every other partition's produce
+                # behind one init. Two racing inits just allocate one
+                # extra pid; the loser's is discarded unused (no
+                # sequences ever attach to it), and both partitions
+                # converge on whichever landed in _producer first.
+                fresh = self.client.init_producer_id()
+                with self._pid_lock:
+                    if self._producer is None:
+                        self._producer = fresh
+                    producer = self._producer
+            pid, epoch = producer
             # Sequences are valid only for the pid that reserved them: a
             # concurrent failure-reset swaps the pid, and a stale entry
             # must read as "start at 0", not leak the old chain.

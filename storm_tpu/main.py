@@ -841,9 +841,8 @@ def _lint_cmd(args) -> int:
             print(f"lint: no such path: {p}", file=sys.stderr)
             return 2
 
-    if args.regen_metric_registry:
+    if args.regen_metric_registry or args.regen_protocol_registry:
         from storm_tpu.analysis.core import iter_python_files, parse_source
-        from storm_tpu.analysis.observability import generate_registry
 
         files = []
         for rel in iter_python_files(["storm_tpu"], root):
@@ -854,14 +853,30 @@ def _lint_cmd(args) -> int:
                 sf = None
             if sf is not None:
                 files.append(sf)
-        out = os.path.join(root, "storm_tpu", "analysis", "metric_names.py")
-        with open(out, "w", encoding="utf-8") as f:
-            f.write(generate_registry(files))
-        print(f"wrote {os.path.relpath(out, root)}", file=sys.stderr)
+        regens = []
+        if args.regen_metric_registry:
+            from storm_tpu.analysis.observability import generate_registry
+            regens.append(("metric_names.py", generate_registry))
+        if args.regen_protocol_registry:
+            from storm_tpu.analysis.protocol import (
+                generate_registry as gen_protocol,
+            )
+            regens.append(("protocol_names.py", gen_protocol))
+        for fname, gen in regens:
+            out = os.path.join(root, "storm_tpu", "analysis", fname)
+            with open(out, "w", encoding="utf-8") as f:
+                f.write(gen(files))
+            print(f"wrote {os.path.relpath(out, root)}", file=sys.stderr)
         return 0
 
     config = load_config(root)
-    findings = run_lint(paths, root, config)
+    timings = {} if args.profile else None
+    findings = run_lint(paths, root, config, timings=timings)
+    if timings is not None:
+        for k in sorted(timings):
+            v = timings[k]
+            v = f"{v:.3f}" if isinstance(v, float) else v
+            print(f"lint profile: {k:<14} {v}", file=sys.stderr)
     baseline_path = os.path.join(root, "storm_tpu", "analysis",
                                  "baseline.json")
     baseline = load_baseline(baseline_path)
@@ -1150,6 +1165,14 @@ def main(argv=None) -> int:
     lintp.add_argument("--regen-metric-registry", action="store_true",
                        help="regenerate storm_tpu/analysis/metric_names.py "
                             "from the tree's metric call sites")
+    lintp.add_argument("--regen-protocol-registry", action="store_true",
+                       help="regenerate storm_tpu/analysis/protocol_names.py "
+                            "from the tree's control/journal/flight-event "
+                            "sites")
+    lintp.add_argument("--profile", action="store_true",
+                       help="print per-phase lint timings (file load, "
+                            "call-graph build, each cross-file pass) to "
+                            "stderr")
 
     args = ap.parse_args(argv)
 

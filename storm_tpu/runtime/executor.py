@@ -197,7 +197,14 @@ class BoltExecutor:
         if self._task is None:
             return
         if drain:
-            await self.inbox.put(_STOP)
+            try:
+                # Bounded: if the run loop already died with a full inbox,
+                # the sentinel can never land, and an unbounded put would
+                # park stop() forever — while rebalance holds the
+                # cluster-wide rebalance lock.
+                await asyncio.wait_for(self.inbox.put(_STOP), timeout=30.0)
+            except asyncio.TimeoutError:  # pragma: no cover
+                self._task.cancel()
             try:
                 await asyncio.wait_for(self._task, timeout=30.0)
             except asyncio.TimeoutError:  # pragma: no cover

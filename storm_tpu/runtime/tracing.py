@@ -28,6 +28,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import json
+import logging
 import os
 import random
 import threading
@@ -35,6 +36,32 @@ import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from storm_tpu.runtime.metrics import MetricsRegistry
+
+log = logging.getLogger("storm_tpu.tracing")
+
+_event_names_checked: set = set()
+
+
+def _check_event_name(kind: str) -> None:
+    """Warn once per flight-event name missing from the generated protocol
+    registry (``storm_tpu/analysis/protocol_names.py``). The static side
+    is lint rule PRT003; this runtime side catches names built from
+    variables or f-strings the AST pass can't resolve. A typo'd event name
+    is otherwise invisible: the recorder happily stores it while every
+    reader (dashboards, fleet scorecard, chaos drills) filters on the
+    spelling that never arrives."""
+    if kind in _event_names_checked:
+        return
+    _event_names_checked.add(kind)
+    try:
+        from storm_tpu.analysis.protocol_names import is_known_event
+    except ImportError:  # registry not generated in this checkout
+        return
+    if not is_known_event(kind):
+        log.warning(
+            "flight event %r is not in the generated protocol registry — "
+            "typo, or run `storm-tpu lint --regen-protocol-registry` "
+            "(PRT003)", kind)
 
 #: Split-phase pipeline substages of one device round trip, in execution
 #: order: ``(histogram/timing key, stage label)``. Single source of truth —
@@ -394,6 +421,7 @@ class FlightRecorder:
         ``throttle_s`` suppresses repeats of the same ``kind`` within the
         window (SLO breaches arrive per-record; one per second is plenty).
         """
+        _check_event_name(kind)  # once per kind: off the hot path
         now = time.time()
         with self._lock:
             if throttle_s > 0.0:

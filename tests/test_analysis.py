@@ -20,9 +20,13 @@ from storm_tpu.analysis import (
     load_config,
     write_baseline,
 )
-from storm_tpu.analysis.core import parse_source
-from storm_tpu.analysis.locks import check_ordering
+from storm_tpu.analysis.callgraph import CallGraph
+from storm_tpu.analysis.core import cross_file_findings, parse_source
+from storm_tpu.analysis.locks import check_cycles, check_ordering, \
+    check_transitive
 from storm_tpu.analysis.observability import check_kinds, generate_registry
+from storm_tpu.analysis.protocol import check_protocols
+from storm_tpu.analysis.threads import check_lifecycles
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -583,7 +587,22 @@ def test_cli_json_schema(capsys):
     assert set(out) == {"findings", "total", "baselined", "new"}
     for f in out["findings"]:
         assert {"rule", "description", "path", "line", "scope", "message",
-                "hint", "key"} <= set(f)
+                "hint", "key", "chain"} <= set(f)
+
+
+def test_cli_json_chain_bearing_finding(capsys):
+    """--json includes the offending call chain on interprocedural
+    findings (LCK003's witness path down to the concrete blocking call)."""
+    from storm_tpu.main import main
+    rc = main(["lint", "--root", ROOT, "--json", "--no-baseline",
+               "storm_tpu/dist/controller.py"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1  # the baselined intentional holds resurface
+    chains = [f for f in out["findings"] if f["chain"]]
+    assert chains, "expected at least one chain-bearing LCK003 finding"
+    for f in chains:
+        assert isinstance(f["chain"], list)
+        assert all(isinstance(s, str) for s in f["chain"])
 
 
 def test_cli_rules_listing(capsys):
@@ -608,3 +627,419 @@ def test_cli_nonzero_on_new_finding(tmp_path, capsys):
     assert main(["lint", "--root", str(tmp_path), "mod.py"]) == 1
     err = capsys.readouterr()
     assert "LCK001" in err.out
+
+
+# ---------------------------------------------------------------------------
+# LCK003: transitively-blocking call under a lock
+# ---------------------------------------------------------------------------
+
+
+def _cross(*srcs, **cfg):
+    files = _files(*srcs)
+    config = LintConfig(**cfg) if cfg else LintConfig()
+    return CallGraph(files, config), files, config
+
+
+_DEEP_BLOCK = """
+    import threading, time
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def top(self):
+            with self._lock:
+                self.mid()
+        def mid(self):
+            self.deep()
+        def deep(self):
+            time.sleep(1)
+"""
+
+
+def test_lck003_catches_blocking_two_frames_below_lock():
+    """The acceptance fixture: the blocking call sits TWO frames below the
+    lock, so depth-1 LCK001 is blind to it and LCK003 must catch it."""
+    assert lint(_DEEP_BLOCK) == []  # LCK001 sees nothing
+    graph, _files_, config = _cross(_DEEP_BLOCK)
+    fs = check_transitive(graph, config)
+    assert [f.rule for f in fs] == ["LCK003"]
+    (f,) = fs
+    assert f.chain == ["mod0.C.mid", "mod0.C.deep", "time.sleep"]
+    assert f.detail == "self.mid->time.sleep"
+    assert "_lock" in f.message and "time.sleep" in f.message
+
+
+def test_lck003_direct_block_stays_lck001():
+    src = """
+        import threading, time
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+    """
+    assert rules_of(lint(src)) == {"LCK001"}
+    graph, _fs, config = _cross(src)
+    assert check_transitive(graph, config) == []  # no double report
+
+
+def test_lck003_nonblocking_callee_ok():
+    graph, _fs, config = _cross("""
+        class C:
+            def top(self):
+                with self._lock:
+                    self.mid()
+            def mid(self):
+                return 1
+    """)
+    assert check_transitive(graph, config) == []
+
+
+def test_lck003_cross_file_chain():
+    graph, _fs, config = _cross("""
+        from mod1 import slow
+        class C:
+            def f(self):
+                with self._lock:
+                    slow()
+    """, """
+        import time
+        def slow():
+            time.sleep(1)
+    """)
+    fs = check_transitive(graph, config)
+    assert [f.rule for f in fs] == ["LCK003"]
+    assert fs[0].chain == ["mod1.slow", "time.sleep"]
+
+
+# ---------------------------------------------------------------------------
+# LCK004: lock-order cycles beyond LCK002's 2-cycle special case
+# ---------------------------------------------------------------------------
+
+
+def test_lck004_three_cycle_flagged():
+    graph, _fs, config = _cross("""
+        class C:
+            def f(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+            def g(self):
+                with self._lock_b:
+                    with self._lock_c:
+                        pass
+            def h(self):
+                with self._lock_c:
+                    with self._lock_a:
+                        pass
+    """)
+    assert check_ordering([], config, edges_in=graph.lock_edges) == []
+    fs = check_cycles(graph, config)
+    assert [f.rule for f in fs] == ["LCK004"]
+    assert len(fs[0].chain) == 3
+    assert "lock-order cycle" in fs[0].message
+
+
+def test_lck004_interprocedural_edge_closes_cycle():
+    """No single function nests a->b; the edge comes from f holding A while
+    calling a function whose lock summary says it takes B."""
+    graph, _fs, config = _cross("""
+        class C:
+            def f(self):
+                with self._lock_a:
+                    self.takes_b()
+            def takes_b(self):
+                with self._lock_b:
+                    pass
+            def g(self):
+                with self._lock_b:
+                    with self._lock_a:
+                        pass
+    """)
+    fs = check_cycles(graph, config)
+    assert [f.rule for f in fs] == ["LCK004"]
+    assert "via self.takes_b()" in fs[0].message
+
+
+def test_lck004_leaves_syntactic_two_cycles_to_lck002():
+    graph, files, config = _cross("""
+        class A:
+            def f(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+            def g(self):
+                with self._lock_b:
+                    with self._lock_a:
+                        pass
+    """)
+    assert check_cycles(graph, config) == []  # LCK002's report, not ours
+    fs = check_ordering(files, config, edges_in=graph.lock_edges)
+    assert [f.rule for f in fs] == ["LCK002"]
+
+
+def test_lck004_consistent_order_ok():
+    graph, _fs, config = _cross("""
+        class C:
+            def f(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+            def g(self):
+                with self._lock_a:
+                    self.h()
+            def h(self):
+                with self._lock_b:
+                    pass
+    """)
+    assert check_cycles(graph, config) == []
+
+
+# ---------------------------------------------------------------------------
+# THR001/THR002: thread and executor lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _thr(*srcs, **cfg):
+    graph, files, config = _cross(*srcs, **cfg)
+    return check_lifecycles(files, config, graph)
+
+
+def test_thr001_unjoined_attr_thread():
+    fs = _thr("""
+        import threading
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+    """)
+    assert [f.rule for f in fs] == ["THR001"]
+    assert fs[0].detail == "thread:self._t"
+
+
+def test_thr001_daemon_ok():
+    assert _thr("""
+        import threading
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+    """) == []
+
+
+def test_thr001_joined_in_close_ok():
+    assert _thr("""
+        import threading
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+            def close(self):
+                self._t.join()
+    """) == []
+
+
+def test_thr001_join_alias_through_for_loop_ok():
+    assert _thr("""
+        import threading
+        def scale_demo():
+            pool = [threading.Thread(target=work) for _ in range(8)]
+            for t in pool:
+                t.start()
+            for t in pool:
+                t.join()
+    """) == []
+
+
+def test_thr001_join_site_must_be_lifecycle_reachable():
+    fs = _thr("""
+        import threading
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+            def _helper_nobody_invokes(self):
+                self._t.join()
+    """)
+    assert [f.rule for f in fs] == ["THR001"]
+    assert "no close/shutdown/stop path reaches" in fs[0].message
+
+
+def test_thr001_finalizer_ok():
+    assert _thr("""
+        import threading, weakref
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+                weakref.finalize(self, _noop, self._t)
+    """) == []
+
+
+def test_thr002_executor_without_shutdown():
+    fs = _thr("""
+        from concurrent.futures import ThreadPoolExecutor
+        class C:
+            def start(self):
+                self._pool = ThreadPoolExecutor(max_workers=2)
+    """)
+    assert [f.rule for f in fs] == ["THR002"]
+    assert fs[0].detail == "executor:self._pool"
+
+
+def test_thr002_context_managed_or_handed_off_ok():
+    assert _thr("""
+        from concurrent import futures
+        def a():
+            with futures.ThreadPoolExecutor(max_workers=2) as pool:
+                pool.submit(print)
+        def b(grpc):
+            server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+            return server
+    """) == []
+
+
+def test_thr002_shutdown_in_close_ok():
+    assert _thr("""
+        from concurrent.futures import ThreadPoolExecutor
+        class C:
+            def start(self):
+                self._pool = ThreadPoolExecutor(max_workers=2)
+            def close(self):
+                self._pool.shutdown(wait=True)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# PRT001-003: protocol conformance
+# ---------------------------------------------------------------------------
+
+
+def _prt(*srcs):
+    return check_protocols(_files(*srcs), LintConfig())
+
+
+def test_prt001_sent_without_handler():
+    fs = _prt("""
+        class Ctl:
+            def kick(self):
+                self.client.control("ping")
+                self.client.control("frobnicate")
+    """, """
+        class Worker:
+            def _control(self, cmd, body):
+                if cmd == "ping":
+                    return {}
+    """)
+    assert [f.detail for f in fs] == ["unhandled:frobnicate"]
+
+
+def test_prt001_handler_without_sender():
+    fs = _prt("""
+        class Ctl:
+            def kick(self):
+                self.client.control("ping")
+    """, """
+        class Worker:
+            def _control(self, cmd, body):
+                if cmd in ("ping", "zap"):
+                    return {}
+    """)
+    assert [f.detail for f in fs] == ["unsent:zap"]
+
+
+def test_prt001_balanced_ok():
+    assert _prt("""
+        class Ctl:
+            def kick(self):
+                self.client.control("ping")
+        class Worker:
+            def _control(self, cmd, body):
+                if cmd == "ping":
+                    return {}
+    """) == []
+
+
+def test_prt002_emitted_kind_without_fold_arm():
+    fs = _prt("""
+        class J:
+            def record(self):
+                self._jappend("rebalance", x=1)
+                self._jappend("mystery", x=2)
+        class S:
+            def apply(self, kind, rec):
+                if kind == "rebalance":
+                    return
+    """)
+    assert [f.detail for f in fs] == ["unfolded:mystery"]
+
+
+def test_prt002_unknown_kind_replay_stays_legal():
+    """Fold arms MAY exceed emitted kinds: an old journal replayed by a new
+    binary hits arms nothing emits any more — that is the forward-compat
+    contract and must not flag."""
+    assert _prt("""
+        class J:
+            def record(self):
+                self._jappend("rebalance", x=1)
+        class S:
+            def apply(self, kind, rec):
+                if kind == "rebalance":
+                    return
+                if kind == "retired_kind":
+                    return
+    """) == []
+
+
+def test_prt003_unregistered_event_name():
+    fs = _prt("""
+        class C:
+            def f(self):
+                self.flight.event("definitely_not_a_registered_event", x=1)
+    """)
+    assert [f.rule for f in fs] == ["PRT003"]
+    assert fs[0].detail == "event:definitely_not_a_registered_event"
+
+
+def test_prt003_registered_event_ok():
+    # dist_worker_draining is a real registered event; **kw leaves the
+    # field set unknowable, so only the name is checked.
+    assert _prt("""
+        class C:
+            def f(self, kw):
+                self.flight.event("dist_worker_draining", **kw)
+    """) == []
+
+
+def test_prt003_missing_required_field():
+    from storm_tpu.analysis import protocol_names
+    required = protocol_names.FLIGHT_EVENTS["dist_worker_draining"]
+    assert "worker" in required  # the contract this fixture violates
+    fs = _prt("""
+        class C:
+            def f(self):
+                self.flight.event("dist_worker_draining")
+    """)
+    assert [f.rule for f in fs] == ["PRT003"]
+    assert fs[0].detail.startswith("fields:dist_worker_draining:")
+
+
+# ---------------------------------------------------------------------------
+# regression: the PR 9 rules are unchanged under the interprocedural engine
+# ---------------------------------------------------------------------------
+
+
+def test_lck001_fixtures_unchanged_under_interprocedural():
+    src = """
+        import threading, time
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+    """
+    fs = lint(src)
+    assert rules_of(fs) == {"LCK001"} and len(fs) == 1
+    extra = cross_file_findings(_files(src), LintConfig())
+    assert [f.rule for f in extra] == []  # nothing doubled, nothing added

@@ -1,0 +1,198 @@
+"""Unit tests for the interprocedural tier (storm_tpu/analysis/callgraph.py).
+
+The graph is deliberately under-approximate — every edge it reports must be
+real — so the tests split two ways: resolution tests prove the edges that
+SHOULD exist do (module functions, imports, self./cls. methods, MRO walk,
+attr/local constructor types), and summary tests prove blocking-ness and
+lock acquisition propagate over those edges with shortest-witness chains.
+"""
+
+import textwrap
+
+from storm_tpu.analysis import LintConfig
+from storm_tpu.analysis.callgraph import CallGraph, module_of
+from storm_tpu.analysis.core import parse_source
+
+
+def _graph(*named, **cfg):
+    files = [parse_source(textwrap.dedent(src), path) for path, src in named]
+    return CallGraph(files, LintConfig(**cfg) if cfg else None)
+
+
+def test_module_of_collapses_packages():
+    assert module_of("storm_tpu/dist/worker.py") == "storm_tpu.dist.worker"
+    assert module_of("storm_tpu/analysis/__init__.py") == "storm_tpu.analysis"
+
+
+# ---------------------------------------------------------------------------
+# resolution
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_module_function():
+    g = _graph(("pkg/a.py", """
+        def helper():
+            pass
+        def caller():
+            helper()
+    """))
+    assert g.functions["pkg.a:caller"].resolved == ["pkg.a:helper"]
+
+
+def test_resolve_self_method_and_attr_type():
+    g = _graph(("pkg/a.py", """
+        class Inner:
+            def work(self):
+                pass
+        class Outer:
+            def __init__(self):
+                self.inner = Inner()
+            def direct(self):
+                self.helper()
+            def helper(self):
+                pass
+            def via_attr(self):
+                self.inner.work()
+    """))
+    assert g.functions["pkg.a:Outer.direct"].resolved == ["pkg.a:Outer.helper"]
+    assert g.functions["pkg.a:Outer.via_attr"].resolved == \
+        ["pkg.a:Inner.work"]
+
+
+def test_resolve_inherited_method_through_base():
+    g = _graph(("pkg/base.py", """
+        class Base:
+            def shared(self):
+                pass
+    """), ("pkg/sub.py", """
+        from pkg.base import Base
+        class Sub(Base):
+            def f(self):
+                self.shared()
+    """))
+    assert g.functions["pkg.sub:Sub.f"].resolved == ["pkg.base:Base.shared"]
+
+
+def test_resolve_imported_function_and_relative_import():
+    g = _graph(("pkg/util.py", """
+        def tool():
+            pass
+    """), ("pkg/a.py", """
+        from .util import tool
+        from pkg import util
+        def f():
+            tool()
+        def h():
+            util.tool()
+    """))
+    assert g.functions["pkg.a:f"].resolved == ["pkg.util:tool"]
+    assert g.functions["pkg.a:h"].resolved == ["pkg.util:tool"]
+
+
+def test_resolve_local_constructor_variable():
+    g = _graph(("pkg/a.py", """
+        class Worker:
+            def run(self):
+                pass
+        def f():
+            w = Worker()
+            w.run()
+    """))
+    # ctor edge (Worker has no __init__, so only the method call resolves)
+    assert g.functions["pkg.a:f"].resolved == ["pkg.a:Worker.run"]
+
+
+def test_dynamic_calls_stay_unresolved():
+    g = _graph(("pkg/a.py", """
+        def f(cb):
+            cb()
+            getattr(cb, "x")()
+    """))
+    assert g.functions["pkg.a:f"].resolved == []
+
+
+# ---------------------------------------------------------------------------
+# blocking summaries
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_summary_propagates_with_shortest_chain():
+    g = _graph(("pkg/a.py", """
+        import time
+        def deep():
+            time.sleep(1)
+        def mid():
+            deep()
+        def top():
+            mid()
+        def clean():
+            pass
+    """))
+    assert g.functions["pkg.a:deep"].may_block
+    assert g.functions["pkg.a:top"].may_block
+    assert not g.functions["pkg.a:clean"].may_block
+    assert g.block_chain("pkg.a:top") == \
+        ["a.top", "a.mid", "a.deep", "time.sleep"]
+
+
+def test_condition_wait_blocks_transitively_but_not_lck001():
+    """Condition.wait on a held lock is LCK001-exempt, but a caller holding
+    a DIFFERENT lock still sleeps — the summary must keep the exemption out
+    of the transitive propagation."""
+    g = _graph(("pkg/a.py", """
+        class C:
+            def park(self):
+                with self._cond:
+                    self._cond.wait()
+    """))
+    fn = g.functions["pkg.a:C.park"]
+    assert fn.may_block  # summary_reason survives the exemption
+    # but the walker's held-aware reason did NOT fire (no LCK001 at the site)
+    assert all(rec.reason is None for rec in fn.calls)
+
+
+def test_scheduled_coroutine_call_is_not_blocking():
+    """create_task(proc.wait()) queues the coroutine — the wrapped call
+    must not count as blocking at this site (shell._terminate's reaper)."""
+    g = _graph(("pkg/a.py", """
+        import asyncio
+        def reap(loop, proc):
+            loop.create_task(proc.wait())
+    """))
+    assert not g.functions["pkg.a:reap"].may_block
+
+
+# ---------------------------------------------------------------------------
+# lock summaries + lifecycle reachability
+# ---------------------------------------------------------------------------
+
+
+def test_transitive_lock_acquisition_closure():
+    g = _graph(("pkg/a.py", """
+        class C:
+            def inner(self):
+                with self._b_lock:
+                    pass
+            def outer(self):
+                with self._a_lock:
+                    self.inner()
+    """))
+    assert g.functions["pkg.a:C.inner"].trans_acquires == {"pkg.a:C._b_lock"}
+    assert g.functions["pkg.a:C.outer"].trans_acquires == \
+        {"pkg.a:C._a_lock", "pkg.a:C._b_lock"}
+
+
+def test_lifecycle_reachable_covers_close_paths_only():
+    g = _graph(("pkg/a.py", """
+        class C:
+            def close(self):
+                self._reap()
+            def _reap(self):
+                pass
+            def _orphan_helper(self):
+                pass
+    """))
+    reach = g.lifecycle_reachable()
+    assert "pkg.a:C.close" in reach
+    assert "pkg.a:C._reap" in reach
+    assert "pkg.a:C._orphan_helper" not in reach

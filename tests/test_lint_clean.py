@@ -45,11 +45,8 @@ def test_baseline_has_no_stale_entries():
     assert stale == [], f"baseline entries with no live finding: {stale}"
 
 
-def test_metric_registry_is_fresh():
-    # the committed metric_names.py must match what --regen-metric-registry
-    # would produce from today's call sites
+def _tree_files():
     from storm_tpu.analysis.core import iter_python_files, parse_source
-    from storm_tpu.analysis.observability import generate_registry
 
     files = []
     for rel in iter_python_files(["storm_tpu"], ROOT):
@@ -57,8 +54,40 @@ def test_metric_registry_is_fresh():
             sf = parse_source(f.read(), rel)
         if sf is not None:
             files.append(sf)
+    return files
+
+
+def test_metric_registry_is_fresh():
+    # the committed metric_names.py must match what --regen-metric-registry
+    # would produce from today's call sites
+    from storm_tpu.analysis.observability import generate_registry
+
     committed = open(os.path.join(
         ROOT, "storm_tpu", "analysis", "metric_names.py")).read()
-    assert generate_registry(files) == committed, \
+    assert generate_registry(_tree_files()) == committed, \
         "metric registry is stale: run `storm-tpu lint " \
         "--regen-metric-registry` and commit the result"
+
+
+def test_protocol_registry_is_fresh():
+    # same gate for protocol_names.py: control commands, journal kinds and
+    # flight events checked by PRT001-003 must be regenerated whenever a
+    # call site changes
+    from storm_tpu.analysis.protocol import generate_registry
+
+    committed = open(os.path.join(
+        ROOT, "storm_tpu", "analysis", "protocol_names.py")).read()
+    assert generate_registry(_tree_files()) == committed, \
+        "protocol registry is stale: run `storm-tpu lint " \
+        "--regen-protocol-registry` and commit the result"
+
+
+def test_lint_wall_clock_budget():
+    # the whole-tree run (parse + per-file rules + call graph + the
+    # interprocedural tier) has to stay cheap enough for tier-1 and for
+    # pre-commit use; --profile prints the same numbers for humans
+    timings = {}
+    config = load_config(ROOT)
+    run_lint(["storm_tpu"], ROOT, config, timings=timings)
+    assert timings["total_s"] < 10.0, \
+        f"lint took {timings['total_s']:.1f}s (budget 10s): {timings}"

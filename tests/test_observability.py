@@ -358,3 +358,30 @@ def test_sampling_off_attaches_no_trace(run):
 
     stats = run(go(), timeout=60)
     assert stats["open"] == 0 and stats["done"] == 0
+
+
+def test_flight_event_warn_once_for_unregistered_names(caplog):
+    """Runtime mirror of PRT003: an event name the generated protocol
+    registry doesn't know warns exactly once; registered names never do."""
+    import logging
+
+    from storm_tpu.runtime import tracing
+    from storm_tpu.runtime.tracing import FlightRecorder
+
+    fr = FlightRecorder()
+    try:
+        tracing._event_names_checked.discard("zz_not_in_registry")
+        with caplog.at_level(logging.WARNING, logger="storm_tpu.tracing"):
+            fr.event("zz_not_in_registry", n=1)
+            fr.event("zz_not_in_registry", n=2)  # second is silent
+        hits = [r for r in caplog.records
+                if "zz_not_in_registry" in r.getMessage()]
+        assert len(hits) == 1
+        assert "regen-protocol-registry" in hits[0].getMessage()
+        caplog.clear()
+        tracing._event_names_checked.discard("dist_worker_draining")
+        with caplog.at_level(logging.WARNING, logger="storm_tpu.tracing"):
+            fr.event("dist_worker_draining", worker=0)
+        assert caplog.records == []
+    finally:
+        fr.close()
