@@ -30,6 +30,7 @@ METRIC_NAMES = frozenset({
     'cascade_shed_pinned',
     'checkpoints',
     'coalesced_sources',
+    'copies_amplification',
     'dead_lettered',
     'delivered',
     'device_ms',
@@ -88,6 +89,8 @@ METRIC_PATTERNS = (
     'cascade_accepted_tier*',
     'cascade_decided_lane_*',
     'cascade_escalated_lane_*',
+    'copies_bytes_per_rec_*',
+    'copies_per_rec_*',
     'dist_circuit_open_w*',
     'e2e_latency_ms_*',
     'fair_rows_*_*',
@@ -114,6 +117,7 @@ METRIC_KINDS = {
     'cascade_shed_pinned': ('counter',),
     'checkpoints': ('counter',),
     'coalesced_sources': ('counter',),
+    'copies_amplification': ('gauge',),
     'dead_lettered': ('counter',),
     'delivered': ('counter',),
     'device_ms': ('histogram',),

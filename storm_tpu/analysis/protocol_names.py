@@ -23,6 +23,7 @@ CONTROL_COMMANDS = frozenset({
     'activate',
     'chaos',
     'component_stats',
+    'copies',
     'deactivate',
     'drain',
     'drain_worker',
@@ -49,6 +50,7 @@ CONTROL_COMMANDS = frozenset({
 CONTROL_SENT = frozenset({
     'activate',
     'component_stats',
+    'copies',
     'deactivate',
     'drain',
     'drain_worker',
@@ -100,6 +102,7 @@ FLIGHT_EVENTS = {
     'bottleneck_shift': ('capacity', 'component', 'device_frac', 'e2e_p95_ms', 'inflow_growth_per_s', 'previous', 'reasons', 'score'),
     'cascade_escalation': (),
     'chaos_injection': ('target',),
+    'copy_amplification_high': ('amplification', 'ceiling', 'ingest_bytes', 'top_bytes_per_record', 'top_stage'),
     'dist_circuit_close': ('peer',),
     'dist_circuit_open': ('opens', 'peer'),
     'dist_heartbeat_miss': ('consecutive', 'error', 'worker'),

@@ -565,6 +565,12 @@ class ObsConfig:
     lag_growth_eps: float = 1.0
     lag_depth_hot: int = 64
     bottleneck_min_score: float = 0.4
+    # Copy ledger (obs/copyledger.py): a ``copy_amplification_high``
+    # flight event fires when the windowed amplification ratio (bytes
+    # moved / bytes ingested) exceeds this ceiling; 0 disables the
+    # check. De-flapped: the event re-arms only after the ratio falls
+    # back under 80% of the ceiling.
+    copy_amp_ceiling: float = 32.0
 
     def __post_init__(self) -> None:
         if self.interval_s <= 0 or self.sentinel_interval_s <= 0:
@@ -587,6 +593,8 @@ class ObsConfig:
                 "need 0 < obs.burn_fast_window_s <= obs.burn_slow_window_s")
         if self.regression_factor <= 1.0:
             raise ValueError("obs.regression_factor must be > 1")
+        if self.copy_amp_ceiling < 0:
+            raise ValueError("obs.copy_amp_ceiling must be >= 0")
 
 
 @dataclass

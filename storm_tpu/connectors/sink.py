@@ -33,6 +33,7 @@ from typing import Any, Callable, Optional
 
 from storm_tpu.config import SinkConfig
 from storm_tpu.connectors.memory import MemoryBroker
+from storm_tpu.obs import copyledger as _copyledger
 from storm_tpu.runtime.base import Bolt, OutputCollector, TopologyContext
 from storm_tpu.runtime.tuples import Tuple, merge_offsets
 
@@ -108,6 +109,9 @@ class BrokerSink(Bolt):
 
     def prepare(self, context: TopologyContext, collector: OutputCollector) -> None:
         super().prepare(context, collector)
+        # Byte-side observability (obs/copyledger): a sink-only worker
+        # still re-encodes every record, so the ledger attaches here too.
+        _copyledger.ensure_installed()
         self.producer = self.make_producer()
         self._latency = context.metrics.histogram(
             context.component_id, "e2e_latency_ms"
@@ -143,8 +147,18 @@ class BrokerSink(Bolt):
         value = t.get("message")
         if isinstance(value, str):
             value = value.encode("utf-8")
+            if _copyledger.active():
+                # Copy ledger: the egress str->bytes re-encode is the
+                # last copy a record pays before the broker.
+                _copyledger.record("sink_encode", len(value), copies=1,
+                                   allocs=1, records=1,
+                                   engine=self.context.component_id)
         elif not isinstance(value, (bytes, bytearray)):
             value = str(value).encode("utf-8")
+            if _copyledger.active():
+                _copyledger.record("sink_encode", len(value), copies=2,
+                                   allocs=2, records=1,
+                                   engine=self.context.component_id)
         key = None
         if "key" in t.fields:
             key = t.get("key")

@@ -33,6 +33,7 @@ from typing import Any, Deque, Dict, Optional, Tuple
 
 from storm_tpu.config import OffsetsConfig
 from storm_tpu.connectors.memory import MemoryBroker, Record
+from storm_tpu.obs import copyledger as _copyledger
 from storm_tpu.runtime.base import Spout, TopologyContext, OutputCollector
 from storm_tpu.runtime.tracing import NOT_SAMPLED
 from storm_tpu.runtime.tuples import Values
@@ -480,11 +481,28 @@ class BrokerSpout(Spout):
                       root_ts, time.perf_counter(), attrs=attrs)
         return ctx
 
+    def _ledger_ingest(self, records: "list[Record]") -> None:
+        """Copy-ledger ingress hops, one call per emit: raw payload bytes
+        as they arrived (the amplification denominator — arrival is not a
+        copy) and, under the "string" scheme, the bytes->str conversion
+        pass that copies every payload."""
+        if not _copyledger.active():
+            return
+        payload = sum(len(r.value) for r in records)
+        comp = self.context.component_id
+        _copyledger.record("spout_ingest", payload, copies=0, allocs=0,
+                           records=len(records), engine=comp)
+        if self.scheme != "raw":
+            _copyledger.record("spout_scheme", payload,
+                               copies=len(records), allocs=len(records),
+                               records=len(records), engine=comp)
+
     async def _emit_chunk(self, records: "list[Record]") -> None:
         first, last = records[0], records[-1]
         msg_id = ("c", first.partition, first.offset, last.offset)
         self.pending[msg_id] = records
         root_ts = self._append_root_ts(first)
+        self._ledger_ingest(records)
         vals = [[self._scheme_value(r.value) for r in records]]
         if self.qos is not None:
             # Chunks are lane-homogeneous (next_tuple groups by lane), so
@@ -505,6 +523,7 @@ class BrokerSpout(Spout):
         msg_id = (rec.partition, rec.offset)
         self.pending[msg_id] = rec
         root_ts = self._append_root_ts(rec)
+        self._ledger_ingest([rec])
         vals = [self._scheme_value(rec.value)]
         if self.qos is not None:
             vals.append(self._lane_of(rec))

@@ -575,6 +575,33 @@ class DistCluster:
                     merged[comp] = vals
         return merged
 
+    def copies(self, key: str = "dist", cumulative: bool = False,
+               reset: bool = False) -> Dict[str, Any]:
+        """Cluster-wide windowed copy-ledger tree: every worker reports
+        its per-(stage, engine) bytes/copies/allocs/records deltas since
+        the last ``copies`` call with the same ``key`` (cursors live
+        worker-side), and the controller ADDs the raw quantities and
+        re-derives bytes-per-record and amplification from the totals —
+        the ``utilization`` merge stance, applied to bytes. First call
+        primes the cursors and reports an empty tree.
+
+        Bench-exact variants: ``reset=True`` clears every worker's
+        ledger (a measured cell starts clean) and ``cumulative=True``
+        merges lifetime totals instead of windows — a cursor can't see
+        a hop born mid-window, so exact per-cell accounting is a reset
+        followed by one cumulative read."""
+        from storm_tpu.obs.copyledger import merge_windows
+
+        req: Dict[str, Any] = {"key": key}
+        if cumulative:
+            req["cumulative"] = True
+        if reset:
+            req["reset"] = True
+        per_worker = {i: c.control("copies", **req)["copies"]
+                      for i, c in enumerate(self.clients)}
+        return {"workers": per_worker,
+                "merged": merge_windows(per_worker)}
+
     def utilization(self, key: str = "dist") -> Dict[str, Any]:
         """Cluster-wide windowed utilization: every worker reports its
         busy/wait/flush deltas since the last ``utilization`` call with

@@ -38,6 +38,7 @@ import grpc
 
 from storm_tpu.dist import wire
 from storm_tpu.dist.wire import WIRE_VERSION
+from storm_tpu.obs import copyledger as _copyledger
 from storm_tpu.resilience.retry import (RETRYABLE_BROAD, RETRYABLE_NARROW,
                                         RetryPolicy, _rpc_code, is_fatal_rpc)
 from storm_tpu.runtime.tracing import TraceContext
@@ -135,7 +136,13 @@ def encode_deliveries(deliveries: Iterable[Tup[str, int, Tuple]]) -> bytes:
     try:
         for j, (c, i, t) in enumerate(deliveries):
             out[j] = [c, i, enc(t, now)]
-        return json.dumps(out).encode("utf-8")
+        payload = json.dumps(out).encode("utf-8")
+        # Copy ledger: the JSON wire serializes every value into the
+        # envelope (dumps) and then re-encodes the whole string to bytes
+        # — two full-payload passes, the cost the binary wire removes.
+        _copyledger.record("wire_encode", len(payload), copies=2,
+                           allocs=2, records=len(deliveries))
+        return payload
     except TypeError as e:
         # The likeliest non-JSON value is a raw-scheme (bytes) payload.
         raise TypeError(
@@ -157,9 +164,14 @@ def decode_deliveries(payload: bytes) -> List[Tup[str, int, Tuple]]:
     if payload[:1] == _BIN_DELIVER:
         return wire.decode_deliveries(payload, time.perf_counter())
     now = time.perf_counter()
-    return [
+    out = [
         (c, i, decode_tuple(enc, now)) for c, i, enc in json.loads(payload)
     ]
+    # Copy ledger: json.loads materializes every value out of the payload
+    # — one full-payload parse/copy pass on the JSON wire.
+    _copyledger.record("wire_decode", len(payload), copies=1,
+                       allocs=len(out), records=len(out))
+    return out
 
 
 def encode_acks(ops: Iterable[Tup[str, int, int]]) -> bytes:

@@ -186,6 +186,16 @@ class DistRuntimeView:
                 "workers": out["workers"],
                 "bottleneck": None}
 
+    async def copies(self) -> Dict[str, Any]:
+        """Dist flavor of the /copies action: the copy-ledger tree
+        merged across workers (controller cursors under the "ui" key —
+        this route's window is between its own calls, never stealing
+        the bench/Observatory deltas)."""
+        out = await asyncio.to_thread(self._dist.copies, "ui")
+        return {"topology": self.name,
+                "copies": out["merged"],
+                "workers": out["workers"]}
+
     async def plan(self, query: dict) -> Dict[str, Any]:
         """Dist flavor of the /plan action. Engines (and their profile
         curves) live in the workers, not the controller, so the

@@ -71,6 +71,7 @@ from typing import Tuple as Tup
 import numpy as np
 
 from storm_tpu.native import crc32c, native_available
+from storm_tpu.obs import copyledger as _copyledger
 from storm_tpu.runtime.tracing import TraceContext
 from storm_tpu.runtime.tuples import Tuple
 
@@ -445,7 +446,12 @@ def encode_deliveries(deliveries: Sequence[Tup[str, int, Tuple]],
         _enc_name(out, component)
         append(_pack_task(task))
         _enc_tuple(out, t, now)
-    return _seal_frame(out, flags)
+    frame = _seal_frame(out, flags)
+    # Copy ledger: the seal's parts-list join is the one full-frame copy
+    # of the encode (slot encodes append views/bytes into the list).
+    _copyledger.record("wire_encode", len(frame), copies=1, allocs=1,
+                       records=len(deliveries))
+    return frame
 
 
 def decode_deliveries(payload,
@@ -473,6 +479,11 @@ def decode_deliveries(payload,
     if pos != end:
         raise WireError(
             f"frame has {end - pos} trailing bytes after {count} deliveries")
+    # Copy ledger: decoding materializes str/bytes slots out of the frame
+    # view (ndarray slots stay zero-copy views — serve/marshal reports
+    # those itself), so one decode pass over the frame counts as one copy.
+    _copyledger.record("wire_decode", len(buf), copies=1,
+                       allocs=count, records=count)
     return deliveries
 
 

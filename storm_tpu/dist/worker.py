@@ -509,6 +509,12 @@ class DistRuntime(TopologyRuntime):
         # Arm the process-wide chaos injector from [chaos] (no-op unless
         # enabled) so submit-recipe chaos reaches every worker.
         install_chaos(getattr(config, "chaos", None), flight=self.flight)
+        # Data-plane copy ledger: attach at worker boot, not just in
+        # operator/sink prepare — a spout-only worker still owes the
+        # ingest rows (the amplification denominator) and the wire hops.
+        from storm_tpu.obs.copyledger import ensure_installed
+
+        ensure_installed()
 
     def _make_sender(self, idx: int, addr: str) -> PeerSender:
         sender = PeerSender(addr, self._wire_format,
@@ -1056,6 +1062,27 @@ class WorkerServer:
 
             return {"index": self.index,
                     "utilization": utilization_snapshot(
+                        self.rt, key=str(req.get("key", "dist")))}
+        if cmd == "copies":
+            # This worker's windowed copy-ledger deltas since the LAST
+            # copies call with the same key (cursors live worker-side,
+            # like utilization). The controller ADDs raw bytes/copies
+            # across workers and re-derives amplification — ratios
+            # don't merge, quantities do. Two bench-exact variants:
+            # ``reset`` clears every hop (a measured cell starts clean)
+            # and ``cumulative`` returns lifetime totals instead of a
+            # window — cursors can't see a hop born mid-window, so
+            # exact per-cell accounting is reset + cumulative read.
+            from storm_tpu.obs import copyledger
+
+            if req.get("reset"):
+                copyledger.copy_ledger().reset()
+                return {"index": self.index, "copies": {}}
+            if req.get("cumulative"):
+                return {"index": self.index,
+                        "copies": copyledger.copy_ledger().snapshot()}
+            return {"index": self.index,
+                    "copies": copyledger.copy_snapshot(
                         self.rt, key=str(req.get("key", "dist")))}
         if cmd == "traces":
             # This worker's slice of the distributed trace picture: the

@@ -32,6 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from storm_tpu.config import BatchConfig, ModelConfig, ShardingConfig
 from storm_tpu.models.registry import ModelDef, build_model, load_or_init
+from storm_tpu.obs import copyledger as _copyledger
 from storm_tpu.parallel.mesh import make_mesh
 from storm_tpu.parallel.sharding import (
     batch_sharding,
@@ -226,6 +227,11 @@ def _fetch_loop(fetch_q: "queue.SimpleQueue", ring: threading.Semaphore,
             handle.timings["d2h_ms"] = (t2 - t1) * 1e3
             handle._out = None
             handle.future.set_result(res[:handle.n])
+            # Copy ledger: the blocking device->host materialization is
+            # one full-result copy into a fresh host array.
+            _copyledger.record("d2h", res.nbytes, copies=1, allocs=1,
+                               records=handle.n,
+                               engine=handle.profile_key or "-")
             # Cost profiler (storm_tpu/obs/profile.py): per-(engine,
             # bucket) curves fed right where all three phase timings are
             # finally known. One sink check per BATCH; must never fail
@@ -789,6 +795,13 @@ class InferenceEngine:
                 np.copyto(buf, f32, casting="unsafe")
             finally:
                 self._staging.release(f32)
+            # Copy ledger: quantized staging is two full-batch passes —
+            # the fused f32 stage write plus the uint8 cast into the
+            # wire buffer (the in-place affine passes rewrite the same
+            # f32 bytes; they are not counted as extra copies).
+            _copyledger.record("staging", f32.nbytes + buf.nbytes,
+                               copies=2, records=n,
+                               engine=self.profile_key or "-")
             with self._lock:
                 xd = jax.device_put(buf, self._x_sharding)
                 out = self._fwd_q(self.params, self.state, xd, scale, offset)
@@ -797,10 +810,20 @@ class InferenceEngine:
                                         self.dtype)
             handle._buf = buf
             self._stage(buf, parts, n)
+            # Copy ledger: the ONE fused host-side write of the
+            # dispatch phase (pad + cast into the pooled buffer).
+            _copyledger.record("staging", buf.nbytes, copies=1,
+                               records=n, engine=self.profile_key or "-")
             with self._lock:
                 xd = jax.device_put(buf, self._x_sharding)
                 out = self._fwd(self.params, self.state, xd)
         t1 = time.perf_counter()
+        # Copy ledger: host->device transfer of the staged buffer (a CPU
+        # backend may alias instead of copying, but the bytes handed to
+        # device_put are the same either way). Recorded after t1 so the
+        # hook never leaks into the h2d_ms timing it sits beside.
+        _copyledger.record("h2d", buf.nbytes, copies=1, records=n,
+                           engine=self.profile_key or "-")
         self.compiled_batches.add(padded)
         if cold:
             _report_compile(self.profile_key, padded, (t1 - t0) * 1e3)

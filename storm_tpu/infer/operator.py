@@ -34,6 +34,7 @@ from storm_tpu.cascade.router import CascadeRouter, Escalated
 from storm_tpu.config import BatchConfig, Config, ModelConfig, ShardingConfig
 from storm_tpu.infer.batcher import Batch, MicroBatcher
 from storm_tpu.infer.engine import InferenceEngine, shared_engine
+from storm_tpu.obs import copyledger as _copyledger
 from storm_tpu.runtime.base import Bolt, OutputCollector, TopologyContext
 from storm_tpu.runtime.tracing import DEVICE_SUBSTAGES, NOT_SAMPLED, span
 from storm_tpu.runtime.tuples import Tuple, Values
@@ -164,6 +165,7 @@ class InferenceBolt(Bolt):
         from storm_tpu.obs.profile import ensure_installed
 
         ensure_installed()
+        _copyledger.ensure_installed()  # byte-side twin, same lifecycle
         # Shared across operator tasks: params live once in HBM; the mesh is
         # the parallelism (vs. the reference's per-bolt model replica).
         self.engine = self._engine or shared_engine(
@@ -408,7 +410,24 @@ class InferenceBolt(Bolt):
                 f"instance shape {tuple(inst.data.shape[1:])} != model "
                 f"input {self.engine.input_shape}"
             )
+        if _copyledger.active():
+            # Copy ledger: the parse writes a fresh float32 array — the
+            # ~57 us/record tax ROADMAP item 2 wants decomposed. Bytes
+            # are the array produced; the JSON text length rides in the
+            # spout rows (scheme/ingest), not here.
+            _copyledger.record("json_decode", inst.data.nbytes, copies=1,
+                               allocs=1, records=1,
+                               engine=self.context.component_id)
         return inst
+
+    def _encode_ledgered(self, preds) -> str:
+        """``encode_predictions`` + the copy-ledger ``json_encode`` hop:
+        the serialization writes one fresh text buffer per record."""
+        msg = encode_predictions(preds)
+        if _copyledger.active():
+            _copyledger.record("json_encode", len(msg), copies=1, allocs=1,
+                               records=1, engine=self.context.component_id)
+        return msg
 
     async def _emit_dead_letter(self, anchor: Tuple, payload, error: str) -> None:
         self._m_dead.inc()
@@ -629,7 +648,7 @@ class InferenceBolt(Bolt):
             anchor = self._anchor_of(item)
             with span(self.context.metrics, self.context.component_id,
                       "encode"):
-                msg = encode_predictions(preds)
+                msg = self._encode_ledgered(preds)
             await self.collector.emit(
                 Values([msg, *self._extras(anchor)]), anchors=[anchor])
             self._complete(item, True)
@@ -852,7 +871,7 @@ class InferenceBolt(Bolt):
                 anchor = self._anchor_of(item)
                 with span(self.context.metrics, self.context.component_id,
                           "encode"):
-                    msg = encode_predictions(preds)
+                    msg = self._encode_ledgered(preds)
                 await self.collector.emit(
                     Values([msg, *self._extras(anchor)]),
                     anchors=[anchor],

@@ -704,6 +704,83 @@ def _bottleneck_cmd(args) -> int:
     return 0
 
 
+def _copies_cmd(args) -> int:
+    """Render the data-plane copy ledger from a running topology's UI
+    endpoint (storm-tpu copies <topology>): per-stage bytes/record and
+    copies/record ranked by bytes moved, plus the derived copy
+    amplification ratio (bytes moved / payload bytes ingested). Against
+    a dist UI the tree is the controller-merged per-worker window."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from storm_tpu.config import env_control_token
+
+    base = args.url.rstrip("/")
+    topo = urllib.parse.quote(args.topology, safe="")
+    req = urllib.request.Request(f"{base}/api/v1/topology/{topo}/copies")
+    token = args.token or env_control_token()
+    if token:  # read route is open; header is harmless if unneeded
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        print(e.read().decode("utf-8", "replace"), file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    # Dist route ships the merged window as "copies"; the local route
+    # ships cumulative totals (always populated) + the Observatory's
+    # latest window.
+    tree = out.get("copies") or out.get("cumulative") or {}
+    stages = tree.get("stages") or {}
+    if not stages:
+        print("no copy-ledger rows yet (record path idle? ledger "
+              "disabled via set_enabled(False)?)")
+        return 0
+    amp = tree.get("copy_amplification")
+    totals = tree.get("totals") or {}
+    print(f"copy amplification: {amp if amp is not None else '-'} "
+          f"(moved {_fmt(totals.get('bytes'))}B / ingested "
+          f"{_fmt(totals.get('ingest_bytes'))}B over "
+          f"{totals.get('ingest_records', 0)} records)")
+    print(f"{'stage':<16} {'B/rec':>10} {'copies/rec':>10} "
+          f"{'bytes':>12} {'copies':>8} {'allocs':>8} {'records':>9}  "
+          f"engines")
+    ranked = sorted(
+        stages.items(),
+        key=lambda kv: -(kv[1].get("bytes") or 0.0))
+    for stage, row in ranked:
+        engines = ",".join(sorted(row.get("engines") or {})) or "-"
+        print(f"{stage:<16} {_fmt(row.get('bytes_per_record')):>10} "
+              f"{_fmt(row.get('copies_per_record')):>10} "
+              f"{_fmt(row.get('bytes')):>12} {row.get('copies', 0):>8} "
+              f"{row.get('allocs', 0):>8} {row.get('records', 0):>9}  "
+              f"{engines}")
+    win = out.get("window") or {}
+    wamp = win.get("copy_amplification")
+    if wamp is not None:
+        print(f"window: amplification={wamp} over {win.get('dt_s')}s "
+              f"(obs step loop)")
+    ceiling = out.get("amp_ceiling")
+    if ceiling:
+        print(f"ceiling: copy_amplification_high fires past "
+              f"{ceiling} (obs.copy_amp_ceiling)")
+    workers = out.get("workers") or {}
+    if workers:
+        for idx in sorted(workers, key=str):
+            t = workers[idx].get("totals") or {}
+            print(f"worker {idx}: moved {_fmt(t.get('bytes'))}B "
+                  f"ingested {_fmt(t.get('ingest_bytes'))}B "
+                  f"amp={workers[idx].get('copy_amplification')}")
+    return 0
+
+
 def _render_solve(out: dict) -> int:
     """Human view of one solver result (shared by the online and offline
     ``storm-tpu plan`` paths)."""
@@ -1094,6 +1171,21 @@ def main(argv=None) -> int:
     bottp.add_argument("--json", action="store_true",
                        help="raw JSON instead of the rendered view")
 
+    copiesp = sub.add_parser(
+        "copies",
+        help="show the data-plane copy ledger for a running topology: "
+             "per-stage bytes/record + copies/record ranked by bytes "
+             "moved, and the copy amplification ratio (dist UIs answer "
+             "with the controller-merged per-worker window)")
+    copiesp.add_argument("topology")
+    copiesp.add_argument("--url", default="http://127.0.0.1:8080",
+                         help="base URL of the daemon's --ui-port server")
+    copiesp.add_argument("--token", default=None,
+                         help="bearer token (default: "
+                              "$STORM_TPU_CONTROL_TOKEN)")
+    copiesp.add_argument("--json", action="store_true",
+                         help="raw JSON instead of the rendered view")
+
     planp = sub.add_parser(
         "plan",
         help="solve for the cheapest config meeting a (rate, p99 SLO) "
@@ -1207,6 +1299,9 @@ def main(argv=None) -> int:
 
     if args.cmd == "bottleneck":
         return _bottleneck_cmd(args)
+
+    if args.cmd == "copies":
+        return _copies_cmd(args)
 
     if args.cmd == "plan":
         return _plan_cmd(args)

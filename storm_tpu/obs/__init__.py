@@ -40,12 +40,14 @@ import logging
 import time
 from typing import List, Optional, Sequence
 
+from storm_tpu.obs import copyledger
 from storm_tpu.obs.bottleneck import BottleneckAttributor
 from storm_tpu.obs.capacity import (
     CapacityTracker,
     EdgeLagTracker,
     utilization_snapshot,
 )
+from storm_tpu.obs.copyledger import CopyLedger, copy_ledger
 from storm_tpu.obs.profile import (
     ProfileStore,
     ensure_installed,
@@ -59,10 +61,12 @@ log = logging.getLogger("storm_tpu.obs")
 __all__ = [
     "BottleneckAttributor",
     "CapacityTracker",
+    "CopyLedger",
     "EdgeLagTracker",
     "Observatory",
     "ProfileStore",
     "SloBurnTracker",
+    "copy_ledger",
     "ensure_installed",
     "profile_store",
     "set_enabled",
@@ -83,6 +87,13 @@ class Observatory:
         self.rt = runtime
         self.cfg = cfg or ObsConfig()
         self.profile = ensure_installed()
+        # Byte-side twin of the profile store: the data-plane copy
+        # ledger (bytes/copies per record-path hop). Attached with the
+        # same idempotent sink-hook pattern; stepped below into
+        # ``copies_*`` gauges and the amplification flight check.
+        self.ledger = copyledger.ensure_installed()
+        self._amp_high = False  # copy_amplification_high de-flap latch
+        self.last_copies: dict = {}  # latest windowed copy tree
         self.burn = SloBurnTracker(
             runtime.metrics,
             components=sink_components,
@@ -158,10 +169,52 @@ class Observatory:
         self.burn.step()
         self._sample_occupancy()
         self.bottleneck.step()
+        self._step_copies()
         now = self.clock()
         if now - self._last_sentinel >= self.cfg.sentinel_interval_s:
             self._last_sentinel = now
             self.sentinel_check()
+
+    def _step_copies(self) -> None:
+        """One windowed read of the copy ledger: publish per-stage
+        bytes/copies-per-record gauges and the amplification ratio, trip
+        the ``copy_amplification_high`` flight event past the configured
+        ceiling (de-flapped: re-arms at 80% of it), and prune hops whose
+        engine/component a rebalance or swap retired."""
+        self.ledger.prune(copyledger.live_keys(self.rt))
+        tree = self.ledger.windowed("obs")
+        self.last_copies = tree
+        metrics = self.rt.metrics
+        for stage, row in tree["stages"].items():
+            if row["bytes_per_record"] is not None:
+                metrics.gauge("obs", f"copies_bytes_per_rec_{stage}").set(
+                    row["bytes_per_record"])
+            if row["copies_per_record"] is not None:
+                metrics.gauge("obs", f"copies_per_rec_{stage}").set(
+                    row["copies_per_record"])
+        amp = tree.get("copy_amplification")
+        metrics.gauge("obs", "copies_amplification").set(
+            amp if amp is not None else 0.0)
+        ceiling = float(self.cfg.copy_amp_ceiling or 0.0)
+        if ceiling <= 0 or amp is None:
+            return
+        if amp > ceiling:
+            if not self._amp_high:
+                self._amp_high = True
+                flight = getattr(self.rt, "flight", None)
+                if flight is not None:
+                    top = max(
+                        tree["stages"].items(),
+                        key=lambda kv: kv[1]["bytes"]
+                        if kv[0] != copyledger.INGEST_STAGE else -1.0)
+                    flight.event(
+                        "copy_amplification_high", throttle_s=5.0,
+                        amplification=amp, ceiling=ceiling,
+                        top_stage=top[0],
+                        top_bytes_per_record=top[1]["bytes_per_record"],
+                        ingest_bytes=tree["totals"]["ingest_bytes"])
+        elif amp < 0.8 * ceiling:
+            self._amp_high = False
 
     def _sample_occupancy(self) -> None:
         for row in self.occupancy():
@@ -231,9 +284,18 @@ class Observatory:
             "baseline_loaded": self.profile.baseline is not None,
             "utilization": self.capacity.last,
             "bottleneck": self.last_verdict(),
+            "copies": self.copies_snapshot(),
             "corrector": (self.corrector.snapshot()
                           if self.corrector is not None else None),
         }
+
+    def copies_snapshot(self) -> dict:
+        """The copy tree both ways: cumulative totals (the CLI table)
+        plus the control loop's latest windowed view (rates — empty
+        until the second step with traffic)."""
+        return {"cumulative": self.ledger.snapshot(),
+                "window": self.last_copies,
+                "amp_ceiling": float(self.cfg.copy_amp_ceiling or 0.0)}
 
     def last_verdict(self) -> dict:
         """Latest attribution verdict (headline of the /bottleneck route).

@@ -51,6 +51,20 @@ STAGE_HISTOGRAMS = (
 DEVICE_SUBSTAGES = (("h2d_ms", "h2d"), ("compute_ms", "compute"),
                     ("d2h_ms", "d2h"))
 
+#: Time stage -> copy-ledger stages that move that stage's bytes: the
+#: critical path pairs each millisecond row with the bytes behind it
+#: ("decode is 40% of e2e AND writes 3 KB/record"), which is the shape
+#: ROADMAP item 2's before/after is scored in.
+STAGE_BYTES = {
+    "queue_wait_ingest": ("spout_ingest", "spout_scheme"),
+    "queue_wait_batch": ("json_decode", "tuple_route"),
+    "queue_wait_dispatch": ("staging",),
+    "device": ("h2d", "d2h"),
+    "other_wire_routing_sink": ("wire_encode", "wire_decode",
+                                "marshal_encode", "marshal_decode",
+                                "json_encode", "sink_encode"),
+}
+
 _WINDOW_KEY = "bottleneck"  # named cursor on every histogram we read
 
 
@@ -231,6 +245,7 @@ class BottleneckAttributor:
                 "mean_ms": round(other, 3),
                 "frac_of_e2e": round(other / e2e_mean, 4) if e2e_mean else None,
             }
+        amp = self._attach_bytes(stages)
         return {
             "e2e_mean_ms": e2e_mean,
             "e2e_p95_ms": round(e2e_p95, 3) if e2e_p95 is not None else None,
@@ -238,4 +253,39 @@ class BottleneckAttributor:
             "stages": stages,
             "device_frac": (stages.get("device", {}).get("frac_of_e2e")
                             if stages else None),
+            "copy_amplification": amp,
         }
+
+    def _attach_bytes(self, stages: Dict[str, dict]) -> Optional[float]:
+        """Pair each time stage with its copy-ledger byte row (the
+        STAGE_BYTES mapping) through the shared ``bottleneck`` windowed
+        cursor — same cadence as the stage-time deltas above, so the
+        milliseconds and the bytes describe the same traffic window.
+        Returns the window's copy-amplification ratio (None before
+        traffic or with the ledger detached)."""
+        from storm_tpu.obs import copyledger
+
+        try:
+            tree = copyledger.copy_ledger().windowed(_WINDOW_KEY)
+        except Exception:
+            return None
+        ledger_stages = tree.get("stages") or {}
+        if not ledger_stages:
+            return None
+        for label, row in stages.items():
+            src = STAGE_BYTES.get(label, ())
+            bpr = cpr = total = 0.0
+            hit = False
+            for name in src:
+                ls = ledger_stages.get(name)
+                if ls is None:
+                    continue
+                hit = True
+                total += ls["bytes"]
+                bpr += ls["bytes_per_record"] or 0.0
+                cpr += ls["copies_per_record"] or 0.0
+            if hit:
+                row["bytes_per_record"] = round(bpr, 1)
+                row["copies_per_record"] = round(cpr, 3)
+                row["bytes"] = round(total, 1)
+        return tree.get("copy_amplification")

@@ -16,6 +16,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
+from storm_tpu.obs import copyledger as _copyledger
 from storm_tpu.runtime.groupings import DirectGrouping
 from storm_tpu.runtime.tracing import NOT_SAMPLED
 from storm_tpu.runtime.tuples import Tuple, Values, merge_offsets, new_id
@@ -208,6 +209,12 @@ class OutputCollector:
             await inbox.put(t)
             n += 1
         self._m_emitted.inc(n)
+        if n and _copyledger.active():
+            # Routing moves references, not payloads: bytes=0 is the
+            # point of the row. Allocations are the probe tuple plus one
+            # fresh Tuple (and values list) per delivery.
+            _copyledger.record("tuple_route", 0, copies=0, allocs=n + 1,
+                               records=n, engine=self.component_id)
         return n
 
     async def emit_direct(

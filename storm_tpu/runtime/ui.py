@@ -497,6 +497,25 @@ class UIServer:
                 out = {"topology": rt.name}
                 out.update(await asyncio.to_thread(obs.bottleneck_snapshot))
                 return 200, out
+            if action == "copies" and method == "GET":
+                # Data-plane copy ledger: bytes/copies per record-path
+                # hop plus the derived amplification ratio. Local
+                # runtimes answer from the attached Observatory (its
+                # windowed view + cumulative totals); without one the
+                # process ledger's cumulative snapshot still answers.
+                # Dist views merge per-worker windows controller-side.
+                if hasattr(rt, "copies"):  # DistRuntimeView
+                    return 200, await rt.copies()
+                obs = getattr(rt, "obs", None)
+                out = {"topology": rt.name}
+                if obs is not None:
+                    out.update(await asyncio.to_thread(obs.copies_snapshot))
+                else:
+                    from storm_tpu.obs.copyledger import copy_ledger
+
+                    out["cumulative"] = await asyncio.to_thread(
+                        copy_ledger().snapshot)
+                return 200, out
             if action == "plan" and method == "GET":
                 # SLO-aware planner (storm_tpu/plan): with ?rate=<rows/s>
                 # &slo_ms=<ms> (optional &engine=, &headroom=) solve over

@@ -27,20 +27,31 @@ from __future__ import annotations
 import numpy as np
 
 from storm_tpu.native import decode_tensor_native, encode_tensor_native
+from storm_tpu.obs import copyledger as _copyledger
+
+
+def _records_of(arr: np.ndarray) -> int:
+    """Batch-axis length as the ledger's record count (scalars/rank-0: 1)."""
+    return int(arr.shape[0]) if arr.ndim else 1
 
 
 def encode_tensor(x: np.ndarray) -> bytes:
     """NumPy array -> Arrow IPC tensor message bytes (C++ fast path)."""
-    x = np.ascontiguousarray(x)
-    out = encode_tensor_native(x)
-    if out is not None:
-        return out
-    import pyarrow as pa
+    c = np.ascontiguousarray(x)
+    out = encode_tensor_native(c)
+    if out is None:
+        import pyarrow as pa
 
-    tensor = pa.Tensor.from_numpy(x)
-    sink = pa.BufferOutputStream()
-    pa.ipc.write_tensor(tensor, sink)
-    return sink.getvalue().to_pybytes()
+        tensor = pa.Tensor.from_numpy(c)
+        sink = pa.BufferOutputStream()
+        pa.ipc.write_tensor(tensor, sink)
+        out = sink.getvalue().to_pybytes()
+    # Copy ledger: the IPC body write is one copy; a non-contiguous
+    # input pays a second (the ascontiguousarray materialization).
+    _copyledger.record("marshal_encode", len(out),
+                       copies=1 if c is x else 2, allocs=1,
+                       records=_records_of(c))
+    return out
 
 
 def decode_tensor(buf) -> np.ndarray:
@@ -48,10 +59,16 @@ def decode_tensor(buf) -> np.ndarray:
 
     ``buf`` may be ``bytes`` or any buffer object (``memoryview``,
     ``bytearray``); the view keeps it alive via the array's base chain."""
-    out = decode_tensor_native(buf)
-    if out is not None:
-        return out
-    import pyarrow as pa
+    arr = decode_tensor_native(buf)
+    if arr is None:
+        import pyarrow as pa
 
-    tensor = pa.ipc.read_tensor(pa.py_buffer(buf))
-    return tensor.to_numpy()
+        tensor = pa.ipc.read_tensor(pa.py_buffer(buf))
+        arr = tensor.to_numpy()
+    # Copy ledger: the decode is a zero-copy view (copies=0 is the row's
+    # whole point) and the measurement must not copy either — the size
+    # comes from the view itself, never from a ``len(bytes(buf))``
+    # round trip that would materialize the frame slice it measures.
+    _copyledger.record("marshal_decode", arr.nbytes, copies=0, allocs=0,
+                       records=_records_of(arr))
+    return arr

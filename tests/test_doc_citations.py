@@ -109,6 +109,49 @@ def test_obs_overhead_artifact_gates():
     assert art["code_version"]
 
 
+def test_copy_ledger_artifact_gates():
+    """BENCH_COPY_r18.json backs the round-18 copy-ledger docs: the
+    per-stage bytes/record decomposition exists for BOTH data-plane
+    arms (string+json vs raw+binary) on BOTH workloads, amplification
+    is > 1.0 everywhere (the numerator excludes ingest, so <= 1.0
+    would mean the ledger missed hops), the scheme hop appears only in
+    the string arm, and the ledger's own interleaved on/off A/B sits
+    within the 2% acceptance bar."""
+    import json
+
+    art = json.loads((REPO / "BENCH_COPY_r18.json").read_text())
+    assert art["metric"] == "copy_ledger_r18"
+    assert art["amplification_gt_1_all_arms"] is True
+    assert {r["workload"] for r in art["rows"]} >= {
+        "framework_null", "lenet5"}
+    for row in art["rows"]:
+        for arm in ("json_string", "binary_raw"):
+            tree = row[arm]
+            assert tree["copy_amplification"] > 1.0
+            stages = tree["stages"]
+            # decomposition rows present, per record, for the path core
+            for need in ("spout_ingest", "json_decode", "tuple_route",
+                         "wire_encode", "wire_decode", "json_encode",
+                         "sink_encode"):
+                assert need in stages, f"{row['workload']}/{arm}: {need}"
+                assert stages[need]["bytes_per_record"] is not None
+                assert stages[need]["copies_per_record"] is not None
+        # the bytes->str scheme hop is the string arm's cost alone
+        assert "spout_scheme" in row["json_string"]["stages"]
+        assert "spout_scheme" not in row["binary_raw"]["stages"]
+    # the real engine pays device-side hops the NullEngine never sees
+    lenet = next(r for r in art["rows"] if r["workload"] == "lenet5")
+    for need in ("staging", "h2d", "d2h"):
+        assert need in lenet["binary_raw"]["stages"]
+    ov = art["overhead"]
+    assert ov["overhead_ok"] is True
+    assert ov["value"] is not None and ov["value"] <= 2.0
+    assert ov["ledger_on"]["samples"] and ov["ledger_off"]["samples"]
+    assert ov["repeats"] >= 5
+    assert art["capture_session"].startswith("cap-")
+    assert art["code_version"]
+
+
 def test_slo_burn_artifact_gates():
     """BENCH_SLO_BURN_r11.json is the early-warning evidence: the burn
     gauge trips BEFORE the shed level moves under the same induced 2x
