@@ -47,6 +47,7 @@ METRIC_NAMES = frozenset({
     'dist_ring_remapped',
     'dist_send_failures',
     'dist_send_retries',
+    'dist_shm_batches',
     'dist_wire_errors',
     'dropped_stale',
     'e2e_latency_ms',
@@ -134,6 +135,7 @@ METRIC_KINDS = {
     'dist_ring_remapped': ('counter',),
     'dist_send_failures': ('counter',),
     'dist_send_retries': ('counter',),
+    'dist_shm_batches': ('counter',),
     'dist_wire_errors': ('counter',),
     'dropped_stale': ('counter',),
     'e2e_latency_ms': ('histogram',),

@@ -44,6 +44,11 @@ class Instances:
     data: np.ndarray  # float32, shape (N, ...)
     # Arrival timestamp (perf_counter seconds) for Kafka->Kafka latency metrics.
     ts: float = 0.0
+    # True when ``data`` is a zero-copy view over the payload buffer
+    # (Arrow tensor fast path): the decode hop cost nothing, and the
+    # ledger must say so (bytes=0, copies=0) instead of charging the
+    # array size the JSON path would have allocated.
+    view: bool = False
 
     @property
     def batch_size(self) -> int:
@@ -115,11 +120,43 @@ def decode_instances(payload: str | bytes, *, ts: float = 0.0) -> Instances:
     ``instObj.getInstances()`` (InferenceBolt.java:76-77), producing a dense
     float32 array. Raises :class:`SchemaError` on any contract violation.
     """
+    # Fastest path: Arrow IPC tensor payload (batch-frame data plane).
+    # An encapsulated Arrow message leads with the 0xFFFFFFFF
+    # continuation marker — no JSON document can start with 0xFF — so
+    # one byte discriminates, and the decode is a zero-copy view over
+    # the payload buffer (``Instances.view=True`` tells the ledger the
+    # parse hop cost nothing).
+    if isinstance(payload, (bytes, bytearray, memoryview)) and \
+            len(payload) >= 1 and payload[0] == 0xFF:
+        from storm_tpu.serve.marshal import decode_tensor
+
+        try:
+            arr = decode_tensor(payload)
+        except Exception as e:
+            raise SchemaError(f"payload is not a valid tensor frame: {e}") \
+                from e
+        view = True
+        if arr.dtype != np.float32:
+            arr = arr.astype(np.float32)  # correctness path, not hot
+            view = False
+        if arr.ndim < 2:
+            raise SchemaError(
+                "instances must have rank >= 2 (batch axis + features); "
+                f"got rank {arr.ndim}")
+        if arr.shape[0] == 0:
+            raise SchemaError("instances batch is empty")
+        return Instances(data=arr, ts=ts, view=view)
+
     # Fast path: native C++ parser (built lazily; falls back transparently).
     # bytes go to the native parser as-is — no utf-8 decode/encode round
     # trip on the hot path; the parser validates the JSON structurally.
     from storm_tpu.native import parse_instances_native
 
+    if isinstance(payload, memoryview):
+        # JSON records arriving as frame views: the parser wants a
+        # contiguous bytes object; this materialization is the same copy
+        # the per-record path always paid.
+        payload = bytes(payload)
     arr = parse_instances_native(payload)
     if arr is None:
         if isinstance(payload, bytes):

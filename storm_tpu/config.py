@@ -82,6 +82,13 @@ class BatchConfig:
     # dropped from the shared-engine cache (so the next build is a fresh
     # replacement) and refuses new dispatches. 0 = never quarantine.
     watchdog_trips: int = 3
+    # Batch-native egress: records that arrived together as a RecordFrame
+    # leave as ONE coalesced predictions payload per dispatched batch
+    # (one encode, one emit, one output message). False restores the
+    # one-output-message-per-record contract even for frame ingress —
+    # for downstream consumers (or harnesses) that count/key per-record
+    # messages — while keeping the zero-copy ingress + view-decode path.
+    frame_egress: bool = True
 
     def __post_init__(self) -> None:
         if float(self.watchdog_ms) < 0:
@@ -288,7 +295,22 @@ class TopologyConfig:
     # "raw" = emit broker bytes untouched, skipping a bytes->str->bytes
     # round trip on the inference hot path. Under dist-run, "raw" needs
     # wire_format="binary" (the default) to cross worker boundaries.
+    # DEPRECATION NOTE (r19): under dist-run the effective default is now
+    # "raw" (+ spout_frames) whenever wire_format="binary" and no scheme
+    # was pinned in the config file or via --set; wire_format="json" still
+    # pins "string" (raw bytes cannot cross the JSON wire — the submit
+    # check rejects that combination with an actionable error). The
+    # "string"-everywhere dist default is deprecated; pin
+    # topology.spout_scheme="string" explicitly to keep it.
     spout_scheme: str = "string"
+    # Batch-native ingress (r19 zero-copy plan): with scheme="raw" and
+    # spout_chunk>1, each chunk rides as ONE RecordFrame tuple value
+    # (runtime/frames.py) — routing moves a reference instead of N
+    # payload objects, the dist wire carries the frame as one slot, and
+    # egress coalesces to one predictions payload per frame group.
+    # Replay/ack granularity is unchanged (the chunk). Off by default
+    # locally; dist-run turns it on alongside the raw-scheme default.
+    spout_frames: bool = False
     # Inter-worker tuple wire under dist-run: "binary" = length-prefixed
     # CRC-protected frames (storm_tpu/dist/wire.py; bytes/ndarray values
     # cross without re-encoding), with per-peer fallback to JSON for
@@ -296,6 +318,15 @@ class TopologyConfig:
     # clusters); "json" = pin the legacy envelope everywhere — the
     # compatibility wire for multilang/shell bolts and old receivers.
     wire_format: str = "binary"
+    # Shared-memory delivery lane between CO-LOCATED dist workers (same
+    # host key, negotiated via the control ping): the sender writes the
+    # encoded delivery frame once into a multiprocessing.shared_memory
+    # segment and ships only a small CRC-protected header over the TCP
+    # stream; the receiver decodes zero-copy views over the segment.
+    # Cross-host peers (or payloads under shm_min_bytes, where segment
+    # setup costs more than the copy it saves) fall back to TCP frames.
+    shm_wire: bool = True
+    shm_min_bytes: int = 65536
     message_timeout_s: float = 30.0  # at-least-once replay timeout
     inbox_capacity: int = 4096  # bounded executor queues (backpressure)
     tick_interval_s: float = 0.0  # 0 = no tick tuples
@@ -423,6 +454,10 @@ def _apply_section(target, values: dict) -> None:
         if isinstance(cur, tuple) and isinstance(v, list):
             v = tuple(v)
         setattr(target, k, v)
+        if k == "spout_scheme" and isinstance(target, TopologyConfig):
+            # dist-run defaults the scheme to "raw" ONLY when the user
+            # never pinned one (file or CLI override) — see main.py.
+            target._scheme_pinned = True
     if hasattr(target, "__post_init__"):
         target.__post_init__()
 

@@ -144,6 +144,11 @@ class BrokerSink(Bolt):
     # ---- mapping (FieldNameBasedTupleToKafkaMapper semantics) ----------------
 
     def _map(self, t: Tuple) -> tuple:
+        # bytes/bytearray values pass through UNTOUCHED: the raw-scheme
+        # operator already produced the utf-8 payload (one json_encode
+        # hop), and re-encoding here was the duplicated sink_encode copy
+        # BENCH_COPY_r18 exposed — the hop now exists only for str
+        # values, which genuinely need the encode.
         value = t.get("message")
         if isinstance(value, str):
             value = value.encode("utf-8")

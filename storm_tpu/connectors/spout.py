@@ -65,6 +65,7 @@ class BrokerSpout(Spout):
         chunk: int = 0,
         scheme: str = "string",
         qos=None,
+        frames: bool = False,
     ) -> None:
         self.broker = broker
         self.topic = topic
@@ -96,13 +97,24 @@ class BrokerSpout(Spout):
         if scheme not in ("string", "raw"):
             raise ValueError(f"unknown spout scheme {scheme!r}")
         self.scheme = scheme
+        # frames=True: chunks travel as ONE RecordFrame tuple value (a
+        # reference move — the ``batch_route`` ledger hop) instead of a
+        # list of N payload objects. Raw bytes only: the string scheme's
+        # per-record decode is exactly the copy frames exist to avoid.
+        if frames and scheme != "raw":
+            raise ValueError(
+                "spout frames need scheme='raw' (record frames carry "
+                "broker bytes by reference; the string scheme decodes "
+                "per record). Set topology.spout_scheme='raw' or disable "
+                "topology.spout_frames.")
+        self.frames = bool(frames)
 
     def clone(self) -> "BrokerSpout":
         """Per-task instance sharing the broker handle (the broker is a
         shared external resource, not per-task state)."""
         return type(self)(self.broker, self.topic, self.offsets_cfg,
                           self.fetch_size, self.chunk, self.scheme,
-                          self.qos)
+                          self.qos, self.frames)
 
     def declare_output_fields(self):
         if self.qos is not None:
@@ -503,7 +515,21 @@ class BrokerSpout(Spout):
         self.pending[msg_id] = records
         root_ts = self._append_root_ts(first)
         self._ledger_ingest(records)
-        vals = [[self._scheme_value(r.value) for r in records]]
+        if self.frames:
+            # Batch ingress (ROADMAP-2 zero-copy): the whole chunk rides
+            # as ONE RecordFrame value — routing moves a reference, not N
+            # payload objects. Replay rebuilds the frame from the same
+            # pending records, so exactly-once is byte-identical on retry.
+            from storm_tpu.runtime.frames import RecordFrame
+
+            frame = RecordFrame([r.value for r in records])
+            if _copyledger.active():
+                _copyledger.record(
+                    "batch_route", 0, copies=0, allocs=1,
+                    records=len(records), engine=self.context.component_id)
+            vals = [frame]
+        else:
+            vals = [[self._scheme_value(r.value) for r in records]]
         if self.qos is not None:
             # Chunks are lane-homogeneous (next_tuple groups by lane), so
             # the first record's lane speaks for the whole tuple.

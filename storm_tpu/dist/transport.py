@@ -24,8 +24,9 @@ CRC-protected, traceparent in the frame header); this module keeps the
 JSON envelope as the negotiated fallback for multilang/shell bolts and
 mixed-version clusters. ``decode_deliveries``/``decode_acks`` below
 auto-detect the format from the first payload byte (JSON arrays start with
-``[`` = 0x5B; binary frames with 0xB7/0xB8), so a receiver accepts either
-regardless of what its own sender half negotiated.
+``[`` = 0x5B; binary frames with 0xB7/0xB8; shared-memory segment headers
+with 0xB9), so a receiver accepts any of them regardless of what its own
+sender half negotiated.
 """
 
 from __future__ import annotations
@@ -48,6 +49,21 @@ SERVICE = "storm_tpu.Dist"
 
 _BIN_DELIVER = bytes((wire.DELIVERY_MAGIC,))
 _BIN_ACK = bytes((wire.ACK_MAGIC,))
+_BIN_SHM = bytes((wire.SHM_MAGIC,))
+
+# Receiver half of the shared-memory lane: one process-wide LRU of
+# attached segments (storm_tpu.dist.shm.SegmentCache), built lazily so
+# importing this module never touches /dev/shm.
+_segments = None
+
+
+def _segment_cache():
+    global _segments
+    if _segments is None:
+        from storm_tpu.dist import shm as _shm_lane
+
+        _segments = _shm_lane.SegmentCache()
+    return _segments
 
 #: Shared-secret control-plane auth (VERDICT r4 missing #4): when set, the
 #: controller exports this env var to its workers, every RPC carries the
@@ -163,6 +179,19 @@ def decode_deliveries(payload: bytes) -> List[Tup[str, int, Tuple]]:
     """
     if payload[:1] == _BIN_DELIVER:
         return wire.decode_deliveries(payload, time.perf_counter())
+    if payload[:1] == _BIN_SHM:
+        # Shared-memory lane: the payload is only a CRC-protected header
+        # naming a segment on THIS host; the frame body is decoded as
+        # zero-copy views over the mapping. Attach/range failures become
+        # WireError so the caller's corruption accounting (and the
+        # sender's leave-to-replay handling) applies unchanged.
+        name, offset, length = wire.decode_shm_header(payload)
+        try:
+            body = _segment_cache().view(name, offset, length)
+        except (OSError, ValueError, RuntimeError) as e:
+            raise wire.WireError(
+                f"shm segment {name!r} unavailable: {e}") from e
+        return wire.decode_deliveries_view(body, time.perf_counter())
     now = time.perf_counter()
     out = [
         (c, i, decode_tuple(enc, now)) for c, i, enc in json.loads(payload)

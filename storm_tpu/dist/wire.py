@@ -42,11 +42,29 @@ Frame layouts (all little-endian)::
       7 ndarray (u32 + Arrow IPC via serve.marshal)
       8 list (u32 count + nested slots)
       9 json (u32 + utf-8 json.dumps — dicts, big ints, exotica)
+      10 record frame (u32 + runtime.frames.RecordFrame body;
+         wire v2 — senders decompose to a list-of-bytes slot and stamp
+         version 1 for peers that only advertise {"wire": 1})
 
     acks frame
       0xB8 | ver u8 | flags u8 | 0 | count u32
       count * ( op u8 | root u64 | edge u64 )      # 17-byte records
       crc u32
+
+    shm header frame (wire v2, co-located workers)
+      0xB9 | ver u8 | flags u8 | 0
+      segment-name vstr | offset u64 | length u64
+      crc u32                      (over the HEADER only — the body
+      already crossed through a local shared-memory segment, where the
+      failure mode a body CRC guards against (bit rot on the network
+      path) does not exist; skipping it is the lane's perf point)
+
+    The shm segment holds an UNSEALED deliveries frame (``0xB7 | ver |
+    flags | 0 | count`` + payload, no CRC trailer), written part-by-part
+    by the sender — that single segment write is the ``shm_transport``
+    ledger hop that replaces socket send+recv AND the encoder's seal
+    join. The receiver decodes zero-copy views over the mapped segment
+    (``decode_deliveries_view``).
 
 ``flags`` bit 0 selects the checksum: 0 = CRC32C (native), 1 = zlib.crc32.
 Decoders raise :class:`WireError` on any magic/version/CRC/structure
@@ -72,23 +90,29 @@ import numpy as np
 
 from storm_tpu.native import crc32c, native_available
 from storm_tpu.obs import copyledger as _copyledger
+from storm_tpu.runtime.frames import RecordFrame
 from storm_tpu.runtime.tracing import TraceContext
 from storm_tpu.runtime.tuples import Tuple
 
 __all__ = [
     "WIRE_VERSION", "WireError",
-    "DELIVERY_MAGIC", "ACK_MAGIC",
+    "DELIVERY_MAGIC", "ACK_MAGIC", "SHM_MAGIC",
     "encode_deliveries", "decode_deliveries",
+    "encode_delivery_parts", "decode_deliveries_view",
+    "encode_shm_header", "decode_shm_header",
     "encode_acks", "decode_acks",
 ]
 
 #: Bumped whenever a frame change is not trailing-compatible. Advertised in
 #: worker ping responses; senders only emit binary to peers that advertise
-#: a version >= the frames they produce.
-WIRE_VERSION = 1
+#: a version >= the frames they produce. v2 adds the record-frame value
+#: slot (tag 10) and the shm header frame (0xB9); senders decompose frame
+#: values and stamp version 1 for v1 peers, so rolling restarts stay safe.
+WIRE_VERSION = 2
 
 DELIVERY_MAGIC = 0xB7
 ACK_MAGIC = 0xB8
+SHM_MAGIC = 0xB9
 
 _CRC_CASTAGNOLI = 0  # flags bit 0 clear: CRC32C via the native layer
 _CRC_ZLIB = 1        # flags bit 0 set: stdlib zlib.crc32
@@ -105,6 +129,7 @@ _T_BYTES = 6
 _T_NDARRAY = 7
 _T_LIST = 8
 _T_JSON = 9
+_T_FRAME = 10  # wire v2: RecordFrame body (runtime/frames.py layout)
 
 _I64_MIN = -(1 << 63)
 _I64_MAX = (1 << 63) - 1
@@ -184,6 +209,12 @@ def _enc_value(out: List[bytes], v) -> None:
         b = encode_tensor(np.ascontiguousarray(v))
         out.append(b"\x07" + _pack_u32(len(b)))
         out.append(b)
+    elif isinstance(v, RecordFrame):
+        # Record frames append as REFERENCES (header + per-record
+        # buffers, runtime/frames.py) — the only whole-frame copy is the
+        # seal join (or the shm segment write, which replaces it).
+        out.append(b"\x0a" + _pack_u32(v.encoded_nbytes()))
+        out.extend(v.encode_parts())
     elif isinstance(v, (list, tuple)):
         out.append(b"\x08" + _pack_u32(len(v)))
         for item in v:
@@ -221,7 +252,7 @@ def _dec_value(buf: memoryview, pos: int, end: int):
         if pos + 8 > end:
             raise WireError("truncated frame: f64 slot")
         return _f64.unpack_from(buf, pos)[0], pos + 8
-    if tag in (_T_STR, _T_BYTES, _T_NDARRAY, _T_JSON):
+    if tag in (_T_STR, _T_BYTES, _T_NDARRAY, _T_JSON, _T_FRAME):
         if pos + 4 > end:
             raise WireError("truncated frame: slot length")
         (n,) = _u32.unpack_from(buf, pos)
@@ -237,6 +268,13 @@ def _dec_value(buf: memoryview, pos: int, end: int):
         if tag == _T_NDARRAY:
             from storm_tpu.serve.marshal import decode_tensor
             return decode_tensor(raw), pos
+        if tag == _T_FRAME:
+            # Zero-copy: the frame's records are memoryview slices over
+            # the received buffer (or the mapped shm segment).
+            try:
+                return RecordFrame.from_buffer(raw), pos
+            except ValueError as exc:
+                raise WireError(f"bad record-frame slot: {exc}") from None
         try:
             return json.loads(bytes(raw)), pos
         except ValueError as exc:
@@ -292,7 +330,8 @@ def _dec_name(buf: memoryview, pos: int, end: int) -> Tup[str, int]:
     return str(buf[pos:pos + n], "utf-8", "surrogatepass"), pos + n
 
 
-def _enc_tuple(out: List[bytes], t: Tuple, now: float) -> None:
+def _enc_tuple(out: List[bytes], t: Tuple, now: float,
+               version: int = WIRE_VERSION) -> None:
     # The whole header concatenates into ONE parts-list entry: a tuple is
     # ~8 tiny pieces (memoized names + a combined struct pack), and one
     # bytes concat beats 15+ list appends — fewer allocations means less
@@ -328,6 +367,11 @@ def _enc_tuple(out: List[bytes], t: Tuple, now: float) -> None:
         raise WireError(f"tuple arity too large for wire: {len(values)}")
     out.append(head + _pack_u16(len(values)))
     for v in values:
+        if version < 2 and isinstance(v, RecordFrame):
+            # v1 peer: no frame slot on its decoder — decompose to the
+            # list-of-bytes shape the legacy chunk path used (copies,
+            # but only during a mixed-version rolling restart).
+            v = v.tolist()
         _enc_value(out, v)
 
 
@@ -403,9 +447,10 @@ def _dec_tuple(buf: memoryview, pos: int, end: int, now: float):
 # frames
 
 
-def _open_frame(magic: int, count: int) -> Tup[List[bytes], int]:
+def _open_frame(magic: int, count: int,
+                version: int = WIRE_VERSION) -> Tup[List[bytes], int]:
     flags = _CRC_CASTAGNOLI if native_available() else _CRC_ZLIB
-    return [bytes((magic, WIRE_VERSION, flags, 0)), _pack_u32(count)], flags
+    return [bytes((magic, version, flags, 0)), _pack_u32(count)], flags
 
 
 def _seal_frame(out: List[bytes], flags: int) -> bytes:
@@ -433,25 +478,74 @@ def _check_frame(payload, magic: int) -> Tup[memoryview, int]:
     return buf, count
 
 
-def encode_deliveries(deliveries: Sequence[Tup[str, int, Tuple]],
-                      now: Optional[float] = None) -> bytes:
-    """Encode ``[(component, task, tuple), ...]`` as one binary frame."""
+def encode_delivery_parts(deliveries: Sequence[Tup[str, int, Tuple]],
+                          now: Optional[float] = None,
+                          version: int = WIRE_VERSION
+                          ) -> Tup[List[bytes], int]:
+    """The deliveries frame as an UNSEALED parts list ``(parts, flags)``.
+
+    For transports that write the frame themselves instead of joining it
+    — the shm lane writes the parts sequentially into a shared-memory
+    segment, making that single write the only whole-frame copy (its
+    ``shm_transport`` ledger hop; no ``wire_encode`` bytes are charged
+    here because no join happened). No CRC trailer: the shm header
+    frame's own CRC is the lane's integrity check."""
     if now is None:
         now = time.perf_counter()
     if not isinstance(deliveries, (list, tuple)):
         deliveries = list(deliveries)
-    out, flags = _open_frame(DELIVERY_MAGIC, len(deliveries))
+    out, flags = _open_frame(DELIVERY_MAGIC, len(deliveries), version)
     append = out.append
     for component, task, t in deliveries:
         _enc_name(out, component)
         append(_pack_task(task))
-        _enc_tuple(out, t, now)
+        _enc_tuple(out, t, now, version)
+    _copyledger.record("wire_encode", 0, copies=0, allocs=0,
+                       records=len(deliveries))
+    return out, flags
+
+
+def encode_deliveries(deliveries: Sequence[Tup[str, int, Tuple]],
+                      now: Optional[float] = None,
+                      version: int = WIRE_VERSION) -> bytes:
+    """Encode ``[(component, task, tuple), ...]`` as one binary frame.
+
+    ``version`` is the NEGOTIATED peer version: frames are stamped with
+    it and v2-only value shapes (record frames) are decomposed for v1
+    peers, so a mixed-version mesh keeps decoding."""
+    if now is None:
+        now = time.perf_counter()
+    if not isinstance(deliveries, (list, tuple)):
+        deliveries = list(deliveries)
+    out, flags = _open_frame(DELIVERY_MAGIC, len(deliveries), version)
+    append = out.append
+    for component, task, t in deliveries:
+        _enc_name(out, component)
+        append(_pack_task(task))
+        _enc_tuple(out, t, now, version)
     frame = _seal_frame(out, flags)
     # Copy ledger: the seal's parts-list join is the one full-frame copy
     # of the encode (slot encodes append views/bytes into the list).
     _copyledger.record("wire_encode", len(frame), copies=1, allocs=1,
                        records=len(deliveries))
     return frame
+
+
+def _dec_deliveries(buf: memoryview, pos: int, end: int, count: int,
+                    now: float) -> List[Tup[str, int, Tuple]]:
+    deliveries = [None] * count
+    for i in range(count):
+        component, pos = _dec_name(buf, pos, end)
+        if pos + 4 > end:
+            raise WireError("truncated frame: delivery task")
+        (task,) = _u32.unpack_from(buf, pos)
+        pos += 4
+        t, pos = _dec_tuple(buf, pos, end, now)
+        deliveries[i] = (component, task, t)
+    if pos != end:
+        raise WireError(
+            f"frame has {end - pos} trailing bytes after {count} deliveries")
+    return deliveries
 
 
 def decode_deliveries(payload,
@@ -466,25 +560,67 @@ def decode_deliveries(payload,
         now = time.perf_counter()
     buf, count = _check_frame(payload, DELIVERY_MAGIC)
     end = len(buf) - 4
-    pos = 8
-    deliveries = [None] * count
-    for i in range(count):
-        component, pos = _dec_name(buf, pos, end)
-        if pos + 4 > end:
-            raise WireError("truncated frame: delivery task")
-        (task,) = _u32.unpack_from(buf, pos)
-        pos += 4
-        t, pos = _dec_tuple(buf, pos, end, now)
-        deliveries[i] = (component, task, t)
-    if pos != end:
-        raise WireError(
-            f"frame has {end - pos} trailing bytes after {count} deliveries")
+    deliveries = _dec_deliveries(buf, 8, end, count, now)
     # Copy ledger: decoding materializes str/bytes slots out of the frame
     # view (ndarray slots stay zero-copy views — serve/marshal reports
     # those itself), so one decode pass over the frame counts as one copy.
     _copyledger.record("wire_decode", len(buf), copies=1,
                        allocs=count, records=count)
     return deliveries
+
+
+def decode_deliveries_view(buf,
+                           now: Optional[float] = None
+                           ) -> List[Tup[str, int, Tuple]]:
+    """Decode an UNSEALED deliveries frame over a mapped shm segment.
+
+    No CRC trailer to verify (the shm header frame's CRC already passed,
+    and a local segment has no network path to rot on); record-frame and
+    ndarray slots stay zero-copy views over the segment, which is what
+    the ``wire_decode`` hop's zeros assert."""
+    if now is None:
+        now = time.perf_counter()
+    buf = memoryview(buf)
+    if len(buf) < 8:
+        raise WireError(f"shm frame body too short: {len(buf)} bytes")
+    if buf[0] != DELIVERY_MAGIC:
+        raise WireError(
+            f"bad magic 0x{buf[0]:02X} in shm segment "
+            f"(want 0x{DELIVERY_MAGIC:02X})")
+    if buf[1] > WIRE_VERSION:
+        raise WireError(
+            f"wire version {buf[1]} newer than supported {WIRE_VERSION}")
+    (count,) = _u32.unpack_from(buf, 4)
+    deliveries = _dec_deliveries(buf, 8, len(buf), count, now)
+    _copyledger.record("wire_decode", 0, copies=0,
+                       allocs=count, records=count)
+    return deliveries
+
+
+def encode_shm_header(name: str, offset: int, length: int) -> bytes:
+    """The 0xB9 header frame pointing a co-located peer at a segment.
+
+    CRC covers the HEADER only — the body never touched the network."""
+    out, flags = _open_frame(SHM_MAGIC, 0)
+    # _open_frame's count slot is unused for shm headers (always 0); the
+    # layout keeps the common 8-byte prefix so _check_frame applies.
+    out.append(_name_bytes(name))
+    out.append(struct.pack("<QQ", offset, length))
+    return _seal_frame(out, flags)
+
+
+def decode_shm_header(payload) -> Tup[str, int, int]:
+    """Validate + decode a 0xB9 header -> ``(segment name, offset,
+    length)``. Raises :class:`WireError` on magic/version/CRC/structure
+    mismatch — a corrupt header must never attach a segment."""
+    buf, _count = _check_frame(payload, SHM_MAGIC)
+    end = len(buf) - 4
+    name, pos = _dec_name(buf, 8, end)
+    if pos + 16 != end:
+        raise WireError(
+            f"shm header length mismatch: {end - pos} trailing bytes")
+    offset, length = struct.unpack_from("<QQ", buf, pos)
+    return name, offset, length
 
 
 def encode_acks(acks: Sequence[Tup[str, int, int]]) -> bytes:

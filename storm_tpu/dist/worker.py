@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple as Tup
 import grpc
 
 from storm_tpu.config import Config, ResilienceConfig
+from storm_tpu.dist import shm as shm_lane
 from storm_tpu.dist import transport, wire
 from storm_tpu.dist.transport import DistHandler, WorkerClient
 from storm_tpu.resilience import (ChaosDrop, CircuitBreaker, RetryPolicy,
@@ -72,7 +73,9 @@ class PeerSender:
     MAX_BATCH_ITEMS = 512
 
     def __init__(self, addr: str, wire_format: str = "binary",
-                 resilience: Optional[ResilienceConfig] = None) -> None:
+                 resilience: Optional[ResilienceConfig] = None,
+                 shm_wire: bool = True,
+                 shm_min_bytes: int = 65536) -> None:
         res = resilience if resilience is not None else ResilienceConfig()
         self.resilience = res
         self._retry = RetryPolicy(
@@ -104,6 +107,15 @@ class PeerSender:
         # on first flush and cached. None = not yet negotiated.
         self._wire_format = wire_format
         self._use_binary: Optional[bool] = None
+        # Peer capability state from the same ping: integer wire version
+        # (frames are stamped with min(ours, theirs) so record-frame
+        # slots are decomposed for v1 peers) and the peer's shm host key
+        # (the shared-memory lane engages only when it equals OURS —
+        # same machine, same boot — and the batch clears shm_min_bytes).
+        self._peer_wire: Optional[int] = None
+        self._peer_shm: Optional[str] = None
+        self._shm_wire = bool(shm_wire) and shm_lane.available()
+        self._shm_min_bytes = int(shm_min_bytes)
         # Recovery pacing state (armed by begin_recovery_pacing).
         self._pacer: Optional[TokenBucket] = None
         self._pace_until = 0.0
@@ -130,6 +142,7 @@ class PeerSender:
                                    f"dist_circuit_open_w{peer_idx}"),
             "parked": metrics.counter("_transport", "dist_parked_batches"),
             "rerouted": metrics.counter("_transport", "dist_rerouted"),
+            "shm": metrics.counter("_transport", "dist_shm_batches"),
             "throttled": metrics.counter("_transport",
                                          "dist_replay_throttled"),
             "throttle_ms": metrics.histogram("_transport",
@@ -265,13 +278,24 @@ class PeerSender:
                     # proxies).
                     tp = next((t.trace.traceparent() for _c, _i, t in tuples
                                if t.trace is not None), None)
-                    enc_tuples = (wire.encode_deliveries if binary
-                                  else transport.encode_deliveries)
-                    await self._send(
-                        functools.partial(self.client.deliver, traceparent=tp),
-                        enc_tuples(tuples),
-                        codes=RETRYABLE_NARROW,
-                    )
+                    deliver = functools.partial(self.client.deliver,
+                                                traceparent=tp)
+                    if binary and self._shm_eligible(tuples):
+                        await self._deliver_shm(deliver, tuples)
+                    else:
+                        # Frames are stamped with the NEGOTIATED version
+                        # (v2-only slots decomposed for v1 peers); an
+                        # un-negotiated peer gets our version optimistically
+                        # — same failure mode as the binary/JSON guess.
+                        ver = min(wire.WIRE_VERSION,
+                                  self._peer_wire if self._peer_wire
+                                  is not None else wire.WIRE_VERSION)
+                        enc_tuples = (
+                            functools.partial(wire.encode_deliveries,
+                                              version=ver)
+                            if binary else transport.encode_deliveries)
+                        await self._send(deliver, enc_tuples(tuples),
+                                         codes=RETRYABLE_NARROW)
                     tuples = []
                 self.circuit.record_success()
                 return
@@ -293,6 +317,40 @@ class PeerSender:
                     return
                 log.warning("peer %s send failed: %s", self.client.target, e)
                 await asyncio.sleep(self._retry.backoff(0))
+
+    async def _deliver_shm(self, deliver, tuples) -> None:
+        """Ship one batch through the shared-memory lane.
+
+        The unsealed v2 frame is written part-by-part into a fresh
+        segment (the lane's ONE copy — ``shm_transport``); only the tiny
+        0xB9 header crosses the RPC. The receiver decodes synchronously
+        inside Deliver, so the segment is closed+unlinked as soon as the
+        send settles — success or permanent failure alike; per-attempt
+        retries inside ``_send`` all happen while it is still alive.
+        Failing to CREATE a segment (/dev/shm full, exhausted fds)
+        disables the lane for this sender and falls back to TCP rather
+        than wedging the peer."""
+        parts, _flags = wire.encode_delivery_parts(tuples)
+        try:
+            seg, length = shm_lane.write_segment(parts)
+        except Exception as e:
+            log.warning("shm lane disabled for peer %s (%s); using TCP",
+                        self.client.target, e)
+            self._shm_wire = False
+            await self._send(deliver, wire.encode_deliveries(tuples),
+                             codes=RETRYABLE_NARROW)
+            return
+        try:
+            header = wire.encode_shm_header(seg.name, 0, length)
+            await self._send(deliver, header, codes=RETRYABLE_NARROW)
+            if "shm" in self._m:
+                self._m["shm"].inc()
+        finally:
+            seg.close()
+            try:
+                seg.unlink()
+            except OSError:  # pragma: no cover - already gone
+                pass
 
     async def _pace(self, n: int) -> None:
         """Recovery-window pacing: wait out the token bucket before
@@ -328,11 +386,26 @@ class PeerSender:
             resp = await asyncio.to_thread(self.client.control, "ping", 5.0)
         except Exception:
             return True
-        self._use_binary = int(resp.get("wire", 0)) >= 1
+        self._peer_wire = int(resp.get("wire", 0))
+        self._peer_shm = resp.get("shm") or None
+        self._use_binary = self._peer_wire >= 1
         if not self._use_binary:
             log.info("peer %s does not advertise the binary wire; "
                      "falling back to the JSON envelope", self.client.target)
         return self._use_binary
+
+    def _shm_eligible(self, tuples) -> bool:
+        """Shared-memory lane preconditions: both halves enabled, peer on
+        the SAME host+boot (ping-advertised key equality — never inferred
+        from the address), peer decodes v2 frames, and the batch is big
+        enough that one segment setup beats the saved socket copies."""
+        if not self._shm_wire or self._peer_shm is None:
+            return False
+        if (self._peer_wire or 0) < 2 or self._peer_shm != shm_lane.host_key():
+            return False
+        nbytes = sum(self._approx_bytes(("t", c, i, t))
+                     for c, i, t in tuples)
+        return nbytes >= self._shm_min_bytes
 
     async def _send(self, fn, payload: bytes, *, codes) -> None:
         """One RPC under the resilience retry policy. Chaos injection
@@ -488,6 +561,9 @@ class DistRuntime(TopologyRuntime):
         self.placement = placement
         set_worker_tag(worker_idx)
         self._wire_format = getattr(config.topology, "wire_format", "binary")
+        self._shm_wire = bool(getattr(config.topology, "shm_wire", True))
+        self._shm_min_bytes = int(
+            getattr(config.topology, "shm_min_bytes", 65536))
         self.senders: Dict[int, PeerSender] = {
             idx: self._make_sender(idx, addr)
             for idx, addr in peers.items() if idx != worker_idx
@@ -518,7 +594,9 @@ class DistRuntime(TopologyRuntime):
 
     def _make_sender(self, idx: int, addr: str) -> PeerSender:
         sender = PeerSender(addr, self._wire_format,
-                            resilience=self.config.resilience)
+                            resilience=self.config.resilience,
+                            shm_wire=self._shm_wire,
+                            shm_min_bytes=self._shm_min_bytes)
         sender.bind_obs(self.metrics, self.flight, idx)
         sender.set_reroute(
             lambda c, i, t, _s=sender: self.reroute_tuple(c, i, t, _s))
@@ -937,9 +1015,17 @@ class WorkerServer:
         if cmd == "ping":
             # "wire" advertises the binary frame version this worker can
             # DECODE; peers that see no key treat us as JSON-only (see
-            # PeerSender._negotiate).
-            return {"ok": True, "index": self.index,
+            # PeerSender._negotiate). "shm" advertises the shared-memory
+            # lane: its value is this host+boot's key, and a sender only
+            # engages the lane when the key equals its OWN (decode always
+            # accepts 0xB9 headers, so the gate is honesty, not safety).
+            resp = {"ok": True, "index": self.index,
                     "wire": wire.WIRE_VERSION}
+            rt = self.rt
+            if shm_lane.available() and (
+                    rt is None or getattr(rt, "_shm_wire", True)):
+                resp["shm"] = shm_lane.host_key()
+            return resp
         if cmd == "state_report":
             # Self-description for controller reattach/reconciliation:
             # works pre-submit (a restarted-by-operator empty worker must
@@ -949,6 +1035,8 @@ class WorkerServer:
                 "ok": True, "index": self.index, "pid": os.getpid(),
                 "submits": self._submits, "wire": wire.WIRE_VERSION,
             }
+            if shm_lane.available():
+                rep["shm"] = shm_lane.host_key()
             rt = self.rt
             if rt is not None:
                 rep["topology"] = rt.name

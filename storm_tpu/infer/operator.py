@@ -27,6 +27,8 @@ import asyncio
 import time
 from typing import Optional, Sequence, Set
 
+import numpy as np
+
 from storm_tpu.api.schema import (
     DeadLetter, Overloaded, SchemaError, decode_instances, encode_predictions)
 from storm_tpu.cascade.policy import CascadeConfig
@@ -36,6 +38,7 @@ from storm_tpu.infer.batcher import Batch, MicroBatcher
 from storm_tpu.infer.engine import InferenceEngine, shared_engine
 from storm_tpu.obs import copyledger as _copyledger
 from storm_tpu.runtime.base import Bolt, OutputCollector, TopologyContext
+from storm_tpu.runtime.frames import RecordFrame
 from storm_tpu.runtime.tracing import DEVICE_SUBSTAGES, NOT_SAMPLED, span
 from storm_tpu.runtime.tuples import Tuple, Values
 
@@ -47,12 +50,16 @@ class _ChunkHandle:
     records dead-letter individually and count as completed — one bad record
     must not replay the whole chunk forever."""
 
-    __slots__ = ("tuple", "remaining", "failed")
+    __slots__ = ("tuple", "remaining", "failed", "frame")
 
-    def __init__(self, t: Tuple, n: int) -> None:
+    def __init__(self, t: Tuple, n: int, frame: bool = False) -> None:
         self.tuple = t
         self.remaining = n
         self.failed = False
+        # frame=True: the chunk arrived as a RecordFrame (batch-native
+        # ingress) — egress coalesces this handle's records into ONE
+        # predictions payload per dispatched batch (see _run_batch).
+        self.frame = frame
 
     def done(self, ok: bool, collector: OutputCollector) -> None:
         self.failed |= not ok
@@ -401,6 +408,28 @@ class InferenceBolt(Bolt):
         else:
             self.collector.fail(item)
 
+    @staticmethod
+    def _egress_groups(emit):
+        """Partition an emit list into frame egress groups, order
+        preserved: consecutive-or-not members of the same frame
+        ``_ChunkHandle`` coalesce under it; everything else stays a
+        singleton keyed ``None``. Returns ``[(handle|None, [(item,
+        preds), ...]), ...]``."""
+        out = []
+        index = {}
+        for item, preds in emit:
+            base = item.payload if isinstance(item, Escalated) else item
+            if isinstance(base, _ChunkHandle) and base.frame:
+                i = index.get(id(base))
+                if i is None:
+                    index[id(base)] = len(out)
+                    out.append((base, [(item, preds)]))
+                else:
+                    out[i][1].append((item, preds))
+            else:
+                out.append((None, [(item, preds)]))
+        return out
+
     def _decode_checked(self, payload, root_ts):
         """Decode + shape-validate one record (raises SchemaError)."""
         with span(self.context.metrics, self.context.component_id, "decode"):
@@ -414,23 +443,49 @@ class InferenceBolt(Bolt):
             # Copy ledger: the parse writes a fresh float32 array — the
             # ~57 us/record tax ROADMAP item 2 wants decomposed. Bytes
             # are the array produced; the JSON text length rides in the
-            # spout rows (scheme/ingest), not here.
-            _copyledger.record("json_decode", inst.data.nbytes, copies=1,
-                               allocs=1, records=1,
-                               engine=self.context.component_id)
+            # spout rows (scheme/ingest), not here. On the tensor-view
+            # fast path nothing was written (the array is a view over
+            # the payload buffer): the row stays, the zeros prove it.
+            if inst.view:
+                _copyledger.record("json_decode", 0, copies=0, allocs=0,
+                                   records=1,
+                                   engine=self.context.component_id)
+            else:
+                _copyledger.record("json_decode", inst.data.nbytes, copies=1,
+                                   allocs=1, records=1,
+                                   engine=self.context.component_id)
         return inst
 
-    def _encode_ledgered(self, preds) -> str:
+    def _encode_ledgered(self, preds, records: int = 1):
         """``encode_predictions`` + the copy-ledger ``json_encode`` hop:
-        the serialization writes one fresh text buffer per record."""
+        the serialization writes one fresh payload per emit.
+
+        Raw-scheme topologies (``_bytes_egress``) get the payload as
+        utf-8 BYTES: the sink produces those bytes verbatim, so the
+        legacy ``sink_encode`` re-encode hop (which duplicated every
+        payload byte, BENCH_COPY_r18) disappears from the path. String
+        topologies keep the str contract (the JSON dist wire and
+        multilang bolts cannot carry bytes)."""
         msg = encode_predictions(preds)
+        if self._bytes_egress:
+            payload = msg.encode("utf-8")
+            if _copyledger.active():
+                _copyledger.record("json_encode", len(payload), copies=1,
+                                   allocs=1, records=records,
+                                   engine=self.context.component_id)
+            return payload
         if _copyledger.active():
             _copyledger.record("json_encode", len(msg), copies=1, allocs=1,
-                               records=1, engine=self.context.component_id)
+                               records=records,
+                               engine=self.context.component_id)
         return msg
 
     async def _emit_dead_letter(self, anchor: Tuple, payload, error: str) -> None:
         self._m_dead.inc()
+        if isinstance(payload, memoryview):
+            # frame-record views: materialize before the envelope (also
+            # releases the view's hold on its wire/shm backing buffer)
+            payload = bytes(payload)
         if isinstance(payload, (bytes, bytearray)):
             # raw-scheme tuples: the DLQ envelope is JSON, so carry the
             # payload as text, not a bytes repr
@@ -447,6 +502,10 @@ class InferenceBolt(Bolt):
         # see their plain `batcher` as the only drain source.
         if name == "_sources":
             return [(None, self.batcher)]
+        # Flipped lazily by execute() on the first raw-scheme payload;
+        # partial skeletons that never execute default to str egress.
+        if name == "_bytes_egress":
+            return False
         raise AttributeError(name)
 
     def _pending(self) -> int:
@@ -514,6 +573,13 @@ class InferenceBolt(Bolt):
             # (broker queueing + spout fetch/decode + inter-operator hop).
             self._m_ingest.observe((time.perf_counter() - t.root_ts) * 1e3)
         payload = t.get("message")
+        if not self._bytes_egress and isinstance(
+                payload, (bytes, bytearray, memoryview, RecordFrame)):
+            # Raw-scheme ingress observed: predictions leave as utf-8
+            # bytes so the sink produces them verbatim (no sink_encode
+            # re-copy). Sticky for the bolt's lifetime — a topology's
+            # scheme is uniform.
+            self._bytes_egress = True
         lane = t.get("qos_lane", None) if self.qos is not None else None
         level = int(self._shed_gauge.value) if self.qos is not None else 0
         if level > 0 and self.qos.shed_eligible(lane, level):
@@ -526,7 +592,8 @@ class InferenceBolt(Bolt):
             # Cascade degrade: the record serves at tier 0 — pinned there
             # by decide(), batched, under normal max_inflight concurrency —
             # so fall through to the regular ingest path.
-            n = len(payload) if isinstance(payload, (list, tuple)) else 1
+            n = (len(payload)
+                 if isinstance(payload, (list, tuple, RecordFrame)) else 1)
             self._m_degraded.inc(n)
             if self._flight is not None:
                 self._flight.event(
@@ -535,7 +602,7 @@ class InferenceBolt(Bolt):
                     lane=lane, level=level, records=n)
         entry = (self._router.entry_tier(lane, level)
                  if self._router is not None else None)
-        if isinstance(payload, (list, tuple)):
+        if isinstance(payload, (list, tuple, RecordFrame)):
             await self._execute_chunk(t, payload, lane, entry)
             return
         try:
@@ -568,7 +635,13 @@ class InferenceBolt(Bolt):
 
     async def _execute_chunk(self, t: Tuple, payloads, lane=None,
                              entry=None) -> None:
-        handle = _ChunkHandle(t, len(payloads))
+        # frame_egress=False keeps the one-output-message-per-record
+        # contract for frame ingress: the handle is marked non-frame so
+        # egress never coalesces (zero-copy ingress/decode is unaffected).
+        handle = _ChunkHandle(t, len(payloads),
+                              frame=(isinstance(payloads, RecordFrame)
+                                     and getattr(self.batch_cfg,
+                                                 "frame_egress", True)))
         for payload in payloads:
             try:
                 inst = self._decode_checked(payload, t.root_ts)
@@ -677,7 +750,9 @@ class InferenceBolt(Bolt):
         trigger replay: replaying rejected load is more load). Graceful
         degradation lives in the cascade: a configured ``qos.degrade_model``
         pins shed traffic to cascade tier 0, so this path is reject-only."""
-        payloads = payload if isinstance(payload, (list, tuple)) else [payload]
+        payloads = (payload
+                    if isinstance(payload, (list, tuple, RecordFrame))
+                    else [payload])
         msg = Overloaded(lane=lane or "", shed_level=level).to_json()
         for _ in payloads:
             await self.collector.emit(
@@ -867,16 +942,36 @@ class InferenceBolt(Bolt):
                          if self.qos is not None else 0)
                 emit, escalated, info = self._router.decide(
                     batch, out, tier, level)
-            for item, preds in emit:
-                anchor = self._anchor_of(item)
+            # Batch egress: records that arrived together as a RecordFrame
+            # leave together — their predictions concatenate into ONE
+            # payload per (frame, dispatched batch), killing the
+            # per-record json_encode fan-out (r19 zero-copy plan). Other
+            # items keep the one-payload-per-record contract.
+            for handle, group in self._egress_groups(emit):
+                if handle is None:
+                    item, preds = group[0]
+                    anchor = self._anchor_of(item)
+                    with span(self.context.metrics,
+                              self.context.component_id, "encode"):
+                        msg = self._encode_ledgered(preds)
+                    await self.collector.emit(
+                        Values([msg, *self._extras(anchor)]),
+                        anchors=[anchor],
+                    )
+                    self._complete(item, True)
+                    continue
+                anchor = handle.tuple
+                preds = (group[0][1] if len(group) == 1 else
+                         np.concatenate([p for _, p in group], axis=0))
                 with span(self.context.metrics, self.context.component_id,
                           "encode"):
-                    msg = self._encode_ledgered(preds)
+                    msg = self._encode_ledgered(preds, records=len(group))
                 await self.collector.emit(
                     Values([msg, *self._extras(anchor)]),
                     anchors=[anchor],
                 )
-                self._complete(item, True)
+                for item, _ in group:
+                    self._complete(item, True)
             if escalated:
                 if self._flight is not None:
                     self._flight.event(

@@ -59,7 +59,7 @@ def build_standard_topology(cfg: Config, broker):
         BrokerSpout(broker, cfg.broker.input_topic, cfg.offsets,
                     chunk=cfg.topology.spout_chunk,
                     scheme=cfg.topology.spout_scheme,
-                    qos=qos),
+                    qos=qos, frames=cfg.topology.spout_frames),
         parallelism=cfg.topology.spout_parallelism,
     )
     tb.set_bolt(
@@ -106,7 +106,7 @@ def build_null_engine_topology(cfg: Config, broker):
         BrokerSpout(broker, cfg.broker.input_topic, cfg.offsets,
                     chunk=cfg.topology.spout_chunk,
                     scheme=cfg.topology.spout_scheme,
-                    qos=qos),
+                    qos=qos, frames=cfg.topology.spout_frames),
         parallelism=cfg.topology.spout_parallelism,
     )
     tb.set_bolt(
@@ -154,7 +154,10 @@ def build_multi_model_topology(cfg: Config, broker):
             BrokerSpout(broker, p.input_topic, p.offsets,
                         chunk=p.spout_chunk or cfg.topology.spout_chunk,
                         scheme=p.spout_scheme or cfg.topology.spout_scheme,
-                        qos=qos),
+                        qos=qos,
+                        frames=(cfg.topology.spout_frames
+                                and (p.spout_scheme
+                                     or cfg.topology.spout_scheme) == "raw")),
             parallelism=p.spout_parallelism,
         )
         tb.set_bolt(
@@ -1317,6 +1320,18 @@ def main(argv=None) -> int:
             print("dist-run needs broker.kind=kafka (workers are separate "
                   "processes; a memory broker cannot be shared)", file=sys.stderr)
             return 2
+        # Dist-run default scheme is "raw" (+ record frames) since r19:
+        # the binary wire (already the default) carries bytes natively,
+        # so the bytes->str->bytes round trip and per-record routing only
+        # survive when the user pins scheme="string" — or pins
+        # wire_format="json", which cannot carry bytes and therefore
+        # keeps the string scheme (the submit-time check would reject
+        # raw+json loudly). See TopologyConfig.spout_scheme deprecation
+        # note.
+        if (not getattr(cfg.topology, "_scheme_pinned", False)
+                and cfg.topology.wire_format != "json"):
+            cfg.topology.spout_scheme = "raw"
+            cfg.topology.spout_frames = True
         from storm_tpu.dist import DistCluster
 
         builder = "multi" if cfg.pipelines else "standard"

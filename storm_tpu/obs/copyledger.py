@@ -14,12 +14,18 @@ stage       boundary
 ========== =====================================================
 spout_ingest  raw broker payload arrival (the amplification denominator)
 spout_scheme  scheme bytes->str conversion in the spout ("string" scheme)
-json_decode   ``{"instances": ...}`` parse -> float32 ndarray
+batch_route   record-frame reference move (zero-copy: bytes=0, copies=0;
+              the row proves N records rode one tuple, ``records`` counts)
+json_decode   ``{"instances": ...}`` parse -> float32 ndarray (bytes=0 on
+              the zero-copy tensor-view fast path)
 tuple_route   tuple materialization + fan-out in the collector
-wire_encode   dist binary/JSON frame encode (``dist/wire.py``)
-wire_decode   dist frame decode back to tuples
+wire_encode   dist binary/JSON frame encode (``dist/wire.py``; bytes=0
+              when the shm lane wrote the frame — see ``shm_transport``)
+wire_decode   dist frame decode back to tuples (bytes=0 over shm views)
+shm_transport shared-memory segment write between co-located dist
+              workers (the ONE copy that replaces socket send+recv)
 marshal_encode  Arrow IPC tensor encode (``serve/marshal.py``)
-marshal_decode  Arrow IPC tensor decode (zero-copy view: copies=0)
+marshal_decode  Arrow IPC tensor decode (zero-copy view: bytes=0, copies=0)
 staging       StagingPool fused pad+cast write (``infer/engine.py``)
 h2d           ``jax.device_put`` host->device transfer
 d2h           fetch-thread ``np.asarray`` device->host copy
@@ -78,8 +84,9 @@ __all__ = [
 #: Record-path order, used for display ranking ties and docs; a stage
 #: missing here still ledgers (sorted last) — the set is not closed.
 STAGE_ORDER = (
-    "spout_ingest", "spout_scheme", "json_decode", "tuple_route",
-    "wire_encode", "wire_decode", "marshal_encode", "marshal_decode",
+    "spout_ingest", "spout_scheme", "batch_route", "json_decode",
+    "tuple_route", "wire_encode", "shm_transport", "wire_decode",
+    "marshal_encode", "marshal_decode",
     "staging", "h2d", "d2h", "json_encode", "sink_encode",
 )
 

@@ -65,10 +65,11 @@ def decode_tensor(buf) -> np.ndarray:
 
         tensor = pa.ipc.read_tensor(pa.py_buffer(buf))
         arr = tensor.to_numpy()
-    # Copy ledger: the decode is a zero-copy view (copies=0 is the row's
-    # whole point) and the measurement must not copy either — the size
-    # comes from the view itself, never from a ``len(bytes(buf))``
-    # round trip that would materialize the frame slice it measures.
-    _copyledger.record("marshal_decode", arr.nbytes, copies=0, allocs=0,
+    # Copy ledger: the decode is a zero-copy view, so it moves ZERO bytes
+    # — same convention as the other view hops (batch_route, wire_decode
+    # over shm): bytes=0, copies=0, with ``records`` proving engagement.
+    # The measurement must not copy either — no ``len(bytes(buf))`` round
+    # trip that would materialize the frame slice it measures.
+    _copyledger.record("marshal_decode", 0, copies=0, allocs=0,
                        records=_records_of(arr))
     return arr

@@ -152,6 +152,49 @@ def test_copy_ledger_artifact_gates():
     assert art["code_version"]
 
 
+def test_zerocopy_artifact_gates():
+    """BENCH_ZEROCOPY_r19.json backs the zero-copy batch-native record
+    path docs: all four acceptance gates hold (framework ceiling >= 3x
+    the interleaved legacy arm, zero-copy amplification <= 1.5 vs the
+    r18 3.451, paced framework p50 < 50 ms, shm lane demonstrably
+    engaged), the per-stage decomposition exists for both arms, the
+    zero-copy arm's view hops moved zero bytes, and the legacy arm
+    replicates the r18 headline cell (scheme hop present, amp ~3.45)."""
+    import json
+
+    art = json.loads((REPO / "BENCH_ZEROCOPY_r19.json").read_text())
+    assert art["metric"] == "zerocopy_speedup_r19"
+    for gate, ok in art["gates"].items():
+        assert ok is True, f"gate {gate} failed at capture time"
+    assert art["value"] >= 3.0
+    assert {r["workload"] for r in art["rows"]} >= {
+        "framework_null", "lenet5"}
+    fw = next(r for r in art["rows"] if r["workload"] == "framework_null")
+    legacy, zc = fw["legacy"], fw["zerocopy"]
+    # the legacy arm replicates the r18 headline plane on this host
+    assert "spout_scheme" in legacy["stages"]
+    assert legacy["copy_amplification"] > 3.0
+    # zero-copy signature: view hops moved nothing, one shm copy hop
+    assert zc["copy_amplification"] <= 1.5
+    for view_stage in ("batch_route", "json_decode"):
+        assert zc["stages"][view_stage]["bytes"] == 0
+        assert zc["stages"][view_stage]["records"] > 0
+    assert "spout_scheme" not in zc["stages"]
+    assert "sink_encode" not in zc["stages"]  # bytes passthrough egress
+    shm = zc["stages"]["shm_transport"]
+    assert shm["bytes"] > 0 and shm["copies"] > 0
+    assert all(s > 0 for s in zc["shm_batches_samples"])
+    assert all(s == 0 for s in legacy["shm_batches_samples"])
+    assert zc["msgs_per_sec_samples"] and legacy["msgs_per_sec_samples"]
+    # paced latency cells, both arms, with the gate margin
+    assert art["latency"]["zerocopy"]["p50_ms"] < 50.0
+    assert art["latency"]["legacy"]["count"] > 0
+    assert art["baseline_r18"]["artifact"] == "BENCH_COPY_r18.json"
+    assert art["repeats"] >= 2
+    assert art["capture_session"].startswith("cap-")
+    assert art["code_version"]
+
+
 def test_slo_burn_artifact_gates():
     """BENCH_SLO_BURN_r11.json is the early-warning evidence: the burn
     gauge trips BEFORE the shed level moves under the same induced 2x
