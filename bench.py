@@ -4916,6 +4916,260 @@ def _run_autoscale_inner(args, cfg, cluster, broker, payloads, n_dev,
     }
 
 
+def run_decode(args) -> dict:
+    """``--decode``: the round-20 stateful decode serving evidence.
+
+    Three measured phases on the in-process runtime (the decode tier is
+    pure-numpy, so there is no wire/broker confound to control for):
+
+    1. **Throughput** — N sessions with ragged budgets (8/24/48 tokens)
+       drive the DecodeBolt through ``ring_fields_grouping`` sticky
+       routing; the headline is delivered tokens/s over the
+       first-submit -> last-ack window, median of ``--repeats``
+       back-to-back cells (each on a fresh engine + arena). TTFT and
+       per-token p50/p99 come from the bolt's own histograms.
+    2. **Exactly-once audit** — an injected mid-stream failure
+       (``fail_after_tokens``) at a commit boundary; the spout replays
+       the request and the captured per-session token streams must be
+       gapless and duplicate-free.
+    3. **Rolling-restart probe** — long-budget sessions, a graceful kill
+       whose drain window is too short for them to finish (so the
+       executor's flush path migrates them), then a resubmit: >= 95% of
+       the sessions live at the kill must come back ``restored == "kv"``
+       with ZERO cold starts, and the cross-restart token streams must
+       stay gapless/duplicate-free.
+
+    The artifact also embeds the observatory's view of the run (decode
+    session rows, KV arena occupancy, the decode engine in the
+    occupancy/profile sweeps) — the "sessions are first-class in the
+    observatories" claim as captured JSON.
+    """
+    import asyncio
+    import tempfile
+
+    from storm_tpu.config import Config
+    from storm_tpu.decode import DecodeBolt, DecodeConfig, SessionSpout
+    from storm_tpu.decode import decode_stats
+    from storm_tpu.decode.engine import _reset_engines
+    from storm_tpu.obs import Observatory
+    from storm_tpu.runtime import TopologyBuilder
+    from storm_tpu.runtime.base import Bolt
+    from storm_tpu.runtime.cluster import AsyncLocalCluster
+
+    repeats = max(1, args.repeats)
+    n_sessions = args.decode_sessions
+    shapes = (8, 24, 48)
+
+    class Cap(Bolt):
+        seen = []
+
+        async def execute(self, t):
+            Cap.seen.append((t.get("session_id"), t.get("token_index")))
+            self.collector.ack(t)
+
+    def mk_reqs(n, tag, budget=None):
+        return [{"session_id": f"{tag}-{i:04d}",
+                 "prompt": f"decode bench {tag} session {i}",
+                 "max_new_tokens": budget or shapes[i % len(shapes)]}
+                for i in range(n)]
+
+    def build(reqs, dcfg, parallelism=2):
+        b = TopologyBuilder()
+        b.set_spout("requests", SessionSpout(reqs), 1)
+        b.set_bolt("decode-bolt", DecodeBolt(dcfg), parallelism) \
+            .ring_fields_grouping("requests", "session_id")
+        b.set_bolt("capture", Cap(), 1).shuffle_grouping("decode-bolt")
+        return b.build()
+
+    def topo_cfg(state_dir=None):
+        cfg = Config()
+        cfg.topology.message_timeout_s = 60.0
+        cfg.topology.checkpoint_interval_s = 5.0
+        if state_dir:
+            cfg.topology.state_dir = state_dir
+        return cfg
+
+    def audit(seen):
+        by = {}
+        for sid, idx in seen:
+            by.setdefault(sid, []).append(idx)
+        dups = sum(len(v) - len(set(v)) for v in by.values())
+        gapped = sum(1 for v in by.values()
+                     if sorted(set(v)) != list(range(len(set(v)))))
+        return {"sessions": len(by), "tokens": len(seen),
+                "duplicates": dups, "gapped_sessions": gapped,
+                "clean": dups == 0 and gapped == 0}
+
+    async def wait_acked(rt, n, deadline_s=120.0):
+        sp = rt.spout_execs["requests"][0].spout
+        t_end = time.perf_counter() + deadline_s
+        while len(sp.acked) < n and time.perf_counter() < t_end:
+            await asyncio.sleep(0.01)
+        return sp
+
+    async def throughput_cell(rep):
+        _reset_engines()
+        Cap.seen = []
+        reqs = mk_reqs(n_sessions, f"tp{rep}")
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit(
+            f"decode-bench-{rep}", topo_cfg(),
+            build(reqs, DecodeConfig(seed=args.seed, arena_blocks=64)))
+        obs = Observatory(rt)  # enables the profile sink for this cell
+        t0 = time.perf_counter()
+        sp = await wait_acked(rt, len(reqs))
+        elapsed = time.perf_counter() - t0
+        assert len(sp.acked) == len(reqs), "throughput cell did not drain"
+        ttft = rt.metrics.histogram("decode-bolt", "decode_ttft_ms")
+        tok = rt.metrics.histogram("decode-bolt", "decode_token_ms")
+        cell = {
+            "tokens": len(Cap.seen),
+            "sessions": len(reqs),
+            "elapsed_s": round(elapsed, 3),
+            "tokens_per_s": round(len(Cap.seen) / elapsed, 1),
+            "ttft_p50_ms": round(ttft.percentile(50), 3),
+            "ttft_p99_ms": round(ttft.percentile(99), 3),
+            "token_p50_ms": round(tok.percentile(50), 3),
+            "token_p99_ms": round(tok.percentile(99), 3),
+            "audit": audit(Cap.seen),
+        }
+        snap = obs.snapshot()
+        cell["observatory"] = {
+            "decode": {k: snap["decode"][k]
+                       for k in ("sessions_live", "tokens_emitted")},
+            "store_rows": len(snap["decode"]["stores"]),
+            "engine_rows": [e for e in snap["decode"]["engines"]],
+            "occupancy": [r for r in snap["occupancy"]
+                          if "decode" in r["engine"]],
+            "profile_keys": sorted(obs.profile.snapshot()["engines"]),
+        }
+        await cluster.shutdown()
+        return cell
+
+    async def audit_cell():
+        """Injected mid-stream failure at a commit boundary; the replay
+        must resume above the watermark."""
+        _reset_engines()
+        Cap.seen = []
+        reqs = mk_reqs(4, "audit", budget=24)
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit(
+            "decode-audit", topo_cfg(),
+            build(reqs, DecodeConfig(seed=args.seed, arena_blocks=16),
+                  parallelism=1))
+        rt.bolt_execs["decode-bolt"][0].bolt.fail_after_tokens = 5
+        sp = await wait_acked(rt, len(reqs))
+        out = audit(Cap.seen)
+        out["injected_failures"] = 1
+        out["request_replays"] = len(sp.failed)
+        out["all_acked"] = len(sp.acked) == len(reqs)
+        await cluster.shutdown()
+        return out
+
+    async def migration_probe():
+        _reset_engines()
+        Cap.seen = []
+        reqs = mk_reqs(12, "mig", budget=150)
+        state_dir = tempfile.mkdtemp(prefix="storm-decode-bench-")
+        cfg = topo_cfg(state_dir)
+        dcfg = DecodeConfig(seed=args.seed, arena_blocks=16,
+                            drain_mode="migrate")
+
+        cluster = AsyncLocalCluster()
+        rt = await cluster.submit("decode-migrate", cfg,
+                                  build(reqs, dcfg))
+        t_end = time.perf_counter() + 60.0
+        while time.perf_counter() < t_end:
+            if len({s for s, _ in Cap.seen}) == len(reqs) \
+                    and len(Cap.seen) >= 4 * len(reqs):
+                break
+            await asyncio.sleep(0.01)
+        bolts = [e.bolt for e in rt.bolt_execs["decode-bolt"]]
+        live_before = sum(
+            1 for b in bolts for s in b.sessions.all() if not s.done)
+        # Graceful kill with a drain window the 150-token budgets cannot
+        # finish inside: flush() suspends the sessions at their commit
+        # boundaries and the final checkpoint carries KV.
+        await cluster.kill("decode-migrate", wait_secs=0.2)
+        tokens_before = len(Cap.seen)
+
+        rt2 = await cluster.submit("decode-migrate", cfg,
+                                   build(reqs, dcfg))
+        sp2 = await wait_acked(rt2, len(reqs))
+        bolts2 = [e.bolt for e in rt2.bolt_execs["decode-bolt"]]
+        kv_restored = sum(1 for b in bolts2 for s in b.sessions.all()
+                          if s.restored == "kv")
+        cold = sum(b.sessions.sessions_cold for b in bolts2)
+        out = {
+            "sessions": len(reqs),
+            "live_at_kill": live_before,
+            "tokens_before_kill": tokens_before,
+            "kv_restored": kv_restored,
+            "cold_started": cold,
+            "survived_frac": round(kv_restored / max(1, live_before), 3),
+            "all_acked_after_restart": len(sp2.acked) == len(reqs),
+            "audit_across_restart": audit(Cap.seen),
+        }
+        await cluster.shutdown()
+        return out
+
+    log(f"decode: throughput x{repeats} "
+        f"({n_sessions} sessions, budgets {shapes})")
+    cells = [asyncio.run(throughput_cell(r)) for r in range(repeats)]
+    log("decode: exactly-once audit (injected failure)")
+    audit_out = asyncio.run(audit_cell())
+    log("decode: rolling-restart migration probe")
+    probe = asyncio.run(migration_probe())
+
+    rates = sorted(c["tokens_per_s"] for c in cells)
+    headline = rates[len(rates) // 2]
+    gates = {
+        "tokens_per_s_positive": headline > 0,
+        "exactly_once_audit_clean": bool(audit_out["clean"]
+                                         and audit_out["all_acked"]),
+        "migration_survived_ge_95pct": probe["survived_frac"] >= 0.95,
+        "migration_zero_cold_started": probe["cold_started"] == 0,
+        "migration_audit_clean": bool(
+            probe["audit_across_restart"]["clean"]),
+        "observatory_decode_rows": bool(
+            cells[-1]["observatory"]["engine_rows"]
+            and cells[-1]["observatory"]["occupancy"]),
+    }
+    log(f"decode: headline {headline} tokens/s; gates "
+        + ", ".join(f"{k}={'OK' if v else 'FAIL'}"
+                    for k, v in gates.items()))
+    return {
+        "metric": "decode_tokens_per_s_r20",
+        "value": headline,
+        "unit": ("delivered decode tokens/s, e2e spout->capture on the "
+                 "in-process runtime (host CPU; chips=0 so the per-chip "
+                 "normalization is the host rate), median of "
+                 f"{repeats} back-to-back cells on fresh arenas"),
+        "tokens_per_s_samples": rates,
+        "cells": cells,
+        "exactly_once_audit": audit_out,
+        "migration_probe": probe,
+        "gates": gates,
+        "sessions_per_cell": n_sessions,
+        "token_budgets": list(shapes),
+        "protocol": ("closed-loop SessionSpout drive; per-cell fresh "
+                     "shared engine + arena (_reset_engines) so no cell "
+                     "inherits warm KV; throughput window is first "
+                     "submit -> last request ack; TTFT/per-token "
+                     "percentiles from the bolt's own histograms over "
+                     "the whole cell; audit = per-session token_index "
+                     "streams gapless + duplicate-free at the capture "
+                     "bolt; migration probe kills gracefully with a "
+                     "drain window shorter than the sessions' budgets "
+                     "so flush() must migrate, then resubmits against "
+                     "the same durable state dir"),
+        "chips": 0,
+        "config": "decode",
+        "capture_session": _new_capture_session(),
+        "code_version": _code_version(),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="resnet20", choices=sorted(CONFIGS))
@@ -5068,6 +5322,15 @@ def main() -> None:
                          "attached: burn-rate gauge vs shed_level "
                          "timeline + live /profile route probe -> "
                          "BENCH_SLO_BURN artifact")
+    ap.add_argument("--decode", action="store_true",
+                    help="stateful decode serving evidence: tokens/s "
+                         "headline + TTFT/per-token percentiles, "
+                         "injected-failure exactly-once audit, and the "
+                         "rolling-restart KV-migration probe -> "
+                         "BENCH_DECODE artifact")
+    ap.add_argument("--decode-sessions", type=int, default=48,
+                    help="sessions per decode throughput cell "
+                         "(ragged 8/24/48-token budgets)")
     ap.add_argument("--fleet", action="store_true",
                     help="trace-driven fleet matrix: every scenario "
                          "(classify/cascade/continuous/serve-path) x every "
@@ -5126,6 +5389,9 @@ def main() -> None:
         return
     if args.slo_burn:
         print(json.dumps(run_slo_burn(args)))
+        return
+    if args.decode:
+        print(json.dumps(run_decode(args)))
         return
     if args.fleet:
         print(json.dumps(run_fleet_matrix(args)))

@@ -25,6 +25,7 @@ CONTROL_COMMANDS = frozenset({
     'component_stats',
     'copies',
     'deactivate',
+    'decode_sessions',
     'drain',
     'drain_worker',
     'health',
@@ -52,6 +53,7 @@ CONTROL_SENT = frozenset({
     'component_stats',
     'copies',
     'deactivate',
+    'decode_sessions',
     'drain',
     'drain_worker',
     'health',
@@ -103,6 +105,9 @@ FLIGHT_EVENTS = {
     'cascade_escalation': (),
     'chaos_injection': ('target',),
     'copy_amplification_high': ('amplification', 'ceiling', 'ingest_bytes', 'top_bytes_per_record', 'top_stage'),
+    'decode_session_evicted': ('cached_rows', 'session'),
+    'decode_session_migrated': ('cached_rows', 'committed', 'session'),
+    'decode_session_started': ('max_new_tokens', 'prompt_len', 'restored', 'session'),
     'dist_circuit_close': ('peer',),
     'dist_circuit_open': ('opens', 'peer'),
     'dist_heartbeat_miss': ('consecutive', 'error', 'worker'),

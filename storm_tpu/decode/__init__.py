@@ -1,0 +1,57 @@
+"""Stateful decode serving (round 20): KV-cache sessions, sticky
+routing, streaming multi-emit.
+
+The classify path treats every tuple as independent; this package adds
+the stateful complement — autoregressive decode where each session
+carries a KV cache between steps:
+
+- :mod:`storm_tpu.decode.kvcache` — per-session KV blocks leased from
+  one preallocated arena (StagingPool discipline), cost-aware eviction,
+  serialize/restore for migration;
+- :mod:`storm_tpu.decode.session` — the session tier: token log,
+  ``committed`` emit watermark (exactly-once across replay), per-task
+  :class:`SessionStore` registry;
+- :mod:`storm_tpu.decode.engine` — the co-batched step kernel: prefill
+  rows, per-token steps, and stateless classify rows share one
+  continuous-batcher queue over one arena;
+- :mod:`storm_tpu.decode.operator` — :class:`DecodeBolt`, the
+  multi-emit stateful operator (one anchored emit per token), sticky
+  via ``ring_fields_grouping("session_id")``, drain-time migration.
+
+``decode_stats()`` is the observatory hook: per-task session rows plus
+arena occupancy, aggregated across every live store/engine in the
+process.
+"""
+
+from __future__ import annotations
+
+from storm_tpu.decode.kvcache import ArenaFullError, KvCacheManager
+from storm_tpu.decode.session import DecodeSession, SessionStore
+from storm_tpu.decode.engine import (
+    DecodeEngine, shared_decode_engine, STATELESS)
+from storm_tpu.decode.operator import (
+    DecodeBolt, DecodeConfig, InjectedFailure, SessionSpout)
+
+__all__ = [
+    "ArenaFullError", "KvCacheManager", "DecodeSession", "SessionStore",
+    "DecodeEngine", "shared_decode_engine", "STATELESS", "DecodeBolt",
+    "DecodeConfig", "InjectedFailure", "SessionSpout", "decode_stats",
+]
+
+
+def decode_stats() -> dict:
+    """Process-wide decode tier snapshot: one row per live
+    :class:`SessionStore` (bolt task) + one per shared engine/arena.
+    Empty lists when the decode tier is idle — the observatory includes
+    the section unconditionally and cheaply."""
+    from storm_tpu.decode.engine import _SHARED, _SHARED_LOCK
+
+    stores = [s.stats() for s in SessionStore.all_stores()]
+    with _SHARED_LOCK:
+        engines = [e.stats() for e in _SHARED.values()]
+    return {
+        "stores": sorted(stores, key=lambda r: (r["component"], r["task"])),
+        "engines": engines,
+        "sessions_live": sum(r["sessions_live"] for r in stores),
+        "tokens_emitted": sum(r["tokens_emitted"] for r in stores),
+    }

@@ -1,0 +1,236 @@
+"""KvCacheManager: per-session KV blocks in one preallocated arena.
+
+The StagingPool lesson (round 13) applied to decode state: allocating a
+fresh (layers, 2, seq, d) slab per session fragments the heap and pays
+an allocation on the per-token hot path; instead ONE arena —
+``(blocks, layers, 2, max_seq, d_model)`` float32 — is allocated up
+front and sessions lease block slots from it. The decode engine writes
+k/v rows straight into the leased slot at the session's next position
+(no per-token allocation, no copy), and attention gathers views over
+``arena[slot, layer, kv, :len]``.
+
+Eviction is **cost-aware**, not LRU: the victim is the idle session with
+the smallest ``cached_len / age`` score — cheapest to recompute (short
+prefix) and coldest (long idle) goes first, so a long-prompt session
+that cost a big prefill is protected from a burst of short newcomers.
+Slots pinned by an in-flight batch are never victims.
+
+``serialize``/``restore`` are the migration path: the used prefix of a
+slot round-trips through a self-describing byte blob (magic + dims +
+length header, float32 payload, trailing-byte check) that rides bolt
+checkpoints (base64) or the dist wire, so a drained/restarted replica
+resumes sessions WITHOUT re-running their prefills.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from storm_tpu.obs import copyledger as _copyledger
+
+_MAGIC = b"KV20"
+_HEADER = struct.Struct("<4sIIII")  # magic, layers, d_model, length, reserved
+
+
+class ArenaFullError(RuntimeError):
+    """Every block is leased and pinned — nothing evictable."""
+
+
+class KvCacheManager:
+    """Slot-leasing KV arena for one decode engine replica.
+
+    Thread-safe: the continuous batcher's dispatcher thread appends k/v
+    during ``predict`` while the operator's event loop acquires/releases
+    slots and the checkpoint path serializes them.
+    """
+
+    def __init__(self, blocks: int, layers: int, max_seq: int,
+                 d_model: int, *, engine_key: str = "decode",
+                 clock: Callable[[], float] = time.monotonic,
+                 on_evict: Optional[Callable[[str, int], None]] = None) -> None:
+        if blocks < 1:
+            raise ValueError(f"arena needs >= 1 block, got {blocks}")
+        self.blocks = int(blocks)
+        self.layers = int(layers)
+        self.max_seq = int(max_seq)
+        self.d_model = int(d_model)
+        self.engine_key = engine_key
+        self.arena = np.zeros(
+            (self.blocks, self.layers, 2, self.max_seq, self.d_model),
+            np.float32)
+        self.lens = np.zeros(self.blocks, np.int32)
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._free: List[int] = list(range(self.blocks - 1, -1, -1))
+        self._owner: Dict[str, int] = {}      # session_id -> slot
+        self._sid: Dict[int, str] = {}        # slot -> session_id
+        self._used_at: Dict[str, float] = {}  # session_id -> last touch
+        self._pins: Dict[int, int] = {}       # slot -> pin refcount
+        self.evictions = 0
+        self.on_evict = on_evict
+
+    # ---- leasing -------------------------------------------------------------
+
+    def slot_of(self, session_id: str) -> Optional[int]:
+        with self._lock:
+            return self._owner.get(session_id)
+
+    def acquire(self, session_id: str) -> int:
+        """Lease a slot for ``session_id`` (idempotent for a live lease).
+        A full arena evicts the cost-aware victim; raises
+        :class:`ArenaFullError` when every slot is pinned."""
+        with self._lock:
+            slot = self._owner.get(session_id)
+            if slot is not None:
+                self._used_at[session_id] = self._clock()
+                return slot
+            if not self._free:
+                self._evict_locked()
+            slot = self._free.pop()
+            self._owner[session_id] = slot
+            self._sid[slot] = session_id
+            self._used_at[session_id] = self._clock()
+            self.lens[slot] = 0
+            return slot
+
+    def _evict_locked(self) -> None:
+        now = self._clock()
+        best_sid, best_score = None, None
+        for sid, slot in self._owner.items():
+            if self._pins.get(slot, 0) > 0:
+                continue
+            age = max(now - self._used_at.get(sid, now), 1e-9)
+            # recompute cost proxy = cached prefix length; colder and
+            # cheaper-to-rebuild sessions score lower and go first
+            score = float(self.lens[slot]) / age
+            if best_score is None or score < best_score:
+                best_sid, best_score = sid, score
+        if best_sid is None:
+            raise ArenaFullError(
+                f"kv arena: all {self.blocks} blocks leased and pinned")
+        slot = self._owner[best_sid]
+        cached = int(self.lens[slot])
+        self._release_locked(best_sid)
+        self.evictions += 1
+        if self.on_evict is not None:
+            self.on_evict(best_sid, cached)
+
+    def release(self, session_id: str) -> None:
+        with self._lock:
+            self._release_locked(session_id)
+
+    def _release_locked(self, session_id: str) -> None:
+        slot = self._owner.pop(session_id, None)
+        if slot is None:
+            return
+        del self._sid[slot]
+        self._used_at.pop(session_id, None)
+        self._pins.pop(slot, None)
+        self.lens[slot] = 0
+        self._free.append(slot)
+
+    def touch(self, session_id: str) -> None:
+        with self._lock:
+            if session_id in self._owner:
+                self._used_at[session_id] = self._clock()
+
+    def pin(self, session_id: str) -> None:
+        """Protect the session's slot from eviction while a batch holding
+        its rows is in flight."""
+        with self._lock:
+            slot = self._owner.get(session_id)
+            if slot is not None:
+                self._pins[slot] = self._pins.get(slot, 0) + 1
+
+    def unpin(self, session_id: str) -> None:
+        with self._lock:
+            slot = self._owner.get(session_id)
+            if slot is not None and self._pins.get(slot, 0) > 0:
+                self._pins[slot] -= 1
+
+    # ---- the engine's write/read surface -------------------------------------
+
+    def append(self, slot: int, layer: int, pos: int,
+               k: np.ndarray, v: np.ndarray) -> None:
+        """Write one position's k/v for one layer (the engine batches
+        this via direct arena indexing; this is the single-row form)."""
+        self.arena[slot, layer, 0, pos] = k
+        self.arena[slot, layer, 1, pos] = v
+        if layer == self.layers - 1 and pos >= self.lens[slot]:
+            self.lens[slot] = pos + 1
+
+    def advance(self, slot: int, new_len: int) -> None:
+        with self._lock:
+            if new_len > self.lens[slot]:
+                self.lens[slot] = new_len
+
+    # ---- migration -----------------------------------------------------------
+
+    def serialize(self, session_id: str) -> Optional[bytes]:
+        """The session's used KV prefix as a self-describing blob, or
+        None for a session without a live slot."""
+        with self._lock:
+            slot = self._owner.get(session_id)
+            if slot is None:
+                return None
+            n = int(self.lens[slot])
+            body = np.ascontiguousarray(
+                self.arena[slot, :, :, :n, :]).tobytes()
+        blob = _HEADER.pack(_MAGIC, self.layers, self.d_model, n, 0) + body
+        if _copyledger.active():
+            _copyledger.record("kv_migrate", len(blob), copies=1, allocs=1,
+                               records=1, engine=self.engine_key)
+        return blob
+
+    def restore(self, session_id: str, blob: bytes) -> int:
+        """Lease a slot and load a serialized prefix into it. Raises
+        ``ValueError`` on dimension mismatch or a malformed blob."""
+        if len(blob) < _HEADER.size:
+            raise ValueError("kv blob shorter than its header")
+        magic, layers, d_model, n, _ = _HEADER.unpack_from(blob, 0)
+        if magic != _MAGIC:
+            raise ValueError(f"kv blob bad magic {magic!r}")
+        if layers != self.layers or d_model != self.d_model:
+            raise ValueError(
+                f"kv blob dims (layers={layers}, d={d_model}) do not match "
+                f"arena (layers={self.layers}, d={self.d_model})")
+        if n > self.max_seq:
+            raise ValueError(
+                f"kv blob length {n} exceeds arena max_seq {self.max_seq}")
+        want = layers * 2 * n * d_model * 4
+        body = blob[_HEADER.size:]
+        if len(body) != want:
+            raise ValueError(
+                f"kv blob body {len(body)}B != expected {want}B")
+        data = np.frombuffer(body, np.float32).reshape(
+            layers, 2, n, d_model)
+        with self._lock:
+            slot = self.acquire(session_id)
+            self.arena[slot, :, :, :n, :] = data
+            self.lens[slot] = n
+        if _copyledger.active():
+            _copyledger.record("kv_migrate", len(blob), copies=1, allocs=0,
+                               records=1, engine=self.engine_key)
+        return slot
+
+    # ---- observability -------------------------------------------------------
+
+    def occupancy(self) -> dict:
+        with self._lock:
+            used = len(self._owner)
+            rows = int(self.lens.sum())
+        row_bytes = self.layers * 2 * self.d_model * 4
+        return {
+            "slots_total": self.blocks,
+            "slots_used": used,
+            "utilization": used / self.blocks,
+            "cached_rows": rows,
+            "cached_bytes": rows * row_bytes,
+            "arena_bytes": int(self.arena.nbytes),
+            "evictions": self.evictions,
+        }

@@ -616,6 +616,31 @@ class DistCluster:
         return {"workers": per_worker,
                 "components": merge_utilization(per_worker)}
 
+    def decode_sessions(self) -> Dict[str, Any]:
+        """Cluster-wide decode tier: each worker's session stores + KV
+        arenas, concatenated. Sticky routing makes per-worker session
+        sets disjoint, so the merged totals are plain sums."""
+        per_worker = {i: c.control("decode_sessions")["decode"]
+                      for i, c in enumerate(self.clients)}
+        stores: List[dict] = []
+        engines: List[dict] = []
+        for i, d in sorted(per_worker.items()):
+            for row in d.get("stores", ()):
+                stores.append({**row, "worker": i})
+            for row in d.get("engines", ()):
+                engines.append({**row, "worker": i})
+        return {"workers": per_worker,
+                "merged": {
+                    "stores": stores,
+                    "engines": engines,
+                    "sessions_live": sum(
+                        d.get("sessions_live", 0)
+                        for d in per_worker.values()),
+                    "tokens_emitted": sum(
+                        d.get("tokens_emitted", 0)
+                        for d in per_worker.values()),
+                }}
+
     def health(self) -> Dict[int, dict]:
         return {i: c.control("health")["health"]
                 for i, c in enumerate(self.clients)}

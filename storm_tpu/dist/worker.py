@@ -1190,6 +1190,21 @@ class WorkerServer:
             if flight is not None:
                 out["flight"] = flight.tail(n)
             return out
+        if cmd == "decode_sessions":
+            # This worker's decode-tier slice: per-task session stores +
+            # KV arena occupancy. The controller concatenates store rows
+            # and sums token counts across workers — session counts are
+            # disjoint by sticky routing, so plain addition is exact.
+            import sys as _sys
+
+            if "storm_tpu.decode" not in _sys.modules:
+                return {"index": self.index,
+                        "decode": {"stores": [], "engines": [],
+                                   "sessions_live": 0,
+                                   "tokens_emitted": 0}}
+            from storm_tpu.decode import decode_stats
+
+            return {"index": self.index, "decode": decode_stats()}
         if cmd == "health":
             return {"health": self.rt.health()}
         if cmd == "deactivate":

@@ -992,6 +992,19 @@ _BUILDS: Dict[tuple, Future] = {}
 # Eviction only ever drops engines nothing outside the cache references,
 # so a cap can never force a live engine to be rebuilt as a duplicate.
 _ENGINE_CACHE_LIMIT: Optional[int] = None
+# Auxiliary engines (round 20): non-classify engines — the decode tier's
+# DecodeEngine above all — register here (weakly) so the observatory's
+# occupancy sweep enumerates them alongside the classify cache without
+# this module importing their packages. They manage their own lifecycle;
+# the cache's HBM cap and eviction never touch them.
+_AUX_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_aux_engine(engine) -> None:
+    """Surface an externally-owned engine through :func:`live_engines`
+    (weak — dropping the last strong ref unregisters it)."""
+    with _ENGINES_LOCK:
+        _AUX_ENGINES.add(engine)
 
 
 def _freeze(v):
@@ -1249,7 +1262,7 @@ def live_engines() -> list:
     ring/staging state lives on the engine objects, not in
     :func:`engine_inventory`'s attribution rows)."""
     with _ENGINES_LOCK:
-        return list(_ENGINES.values())
+        return list(_ENGINES.values()) + list(_AUX_ENGINES)
 
 
 def engine_inventory() -> dict:

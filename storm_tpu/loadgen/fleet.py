@@ -42,7 +42,7 @@ from storm_tpu.loadgen.trace import Trace, TraceSpec, generate, replay
 __all__ = ["run_fleet", "SCENARIOS", "PATTERNS"]
 
 PATTERNS = ("heavy_tail", "diurnal", "flash_crowd")
-SCENARIOS = ("classify", "cascade", "continuous", "serve_path")
+SCENARIOS = ("classify", "cascade", "continuous", "serve_path", "decode")
 
 #: Offered load as a fraction of the scenario's probed OPEN-LOOP
 #: sustained capacity (see ``_probe_capacity``), where the pattern's
@@ -55,7 +55,12 @@ SCENARIOS = ("classify", "cascade", "continuous", "serve_path")
 #: deliberately clears capacity by ~1.5x (0.5 * 3.0), which is what
 #: forces the protection stack to engage.
 _PATTERN_RATE_FRAC = {"heavy_tail": 0.55, "diurnal": 0.40,
-                      "flash_crowd": 0.50}
+                      "flash_crowd": 0.50,
+                      # decode: session arrivals at half the probed
+                      # sustained session rate — long sessions overlap
+                      # arrival waves, so occupancy (KV slots) is the
+                      # pressured axis, not instantaneous rate.
+                      "decode_sessions": 0.50}
 _FLASH_MULT = 3.0
 
 
@@ -138,6 +143,11 @@ class _Scenario:
 
     name = "?"
     sink = "kafka-bolt"
+    #: Component whose inbox/batch-wait the shed controller watches.
+    shed_component = "inference-bolt"
+    #: None = run the matrix's default pattern set; a scenario that only
+    #: makes sense under its own traffic (decode) narrows it.
+    patterns: Optional[tuple] = None
 
     def setup(self) -> None:  # once, before the scenario's cells
         pass
@@ -151,6 +161,21 @@ class _Scenario:
 
     def build(self, slo_ms: float):
         raise NotImplementedError
+
+    def probe(self, cluster, slo_ms: float, log: Callable) -> float:
+        """Sustained capacity in OFFERED records/s (cells rate against
+        it). The default measures sink deliveries == offered records;
+        multi-emit scenarios (decode) override."""
+        return _probe_capacity(cluster, self, slo_ms, log)
+
+    def targets(self, pattern: str, slo_ms: float,
+                spec: TraceSpec) -> CellTargets:
+        return _targets_for(pattern, slo_ms)
+
+    def extra_scores(self, rt, snap: dict, scores: dict) -> dict:
+        """Scenario-specific score axes merged into the cell's scores
+        before gating (decode: tokens/s goodput, TTFT p99)."""
+        return {}
 
 
 class _StandardScenario(_Scenario):
@@ -315,6 +340,141 @@ class _ServeScenario(_Scenario):
         return broker, cfg, tb.build()
 
 
+class _DecodeScenario(_Scenario):
+    """The decode column: BrokerSpout -> DecodeBolt -> BrokerSink under
+    SESSION-arrival traffic (``decode_sessions`` pattern only — record
+    patterns measure a different thing). Each trace event produces one
+    session request; the shape axis is the ragged length distribution
+    (s1 -> short sessions, s8 -> long), so one sink delivery is one
+    TOKEN and the cell gates on tokens/s goodput + session TTFT p99
+    instead of record goodput. Payload pools are large (one distinct
+    session id per entry) so a hold opens fresh sessions instead of
+    endlessly extending a handful; pool wrap-around turns into
+    follow-up turns on retained KV, which is real serving too."""
+
+    #: tokens per session by shape class (mix 0.7/0.3 -> mean ~10)
+    TOKENS = {"s1": 4, "s8": 24}
+    _POOL = 4096
+
+    def __init__(self) -> None:
+        self.name = "decode"
+        self.sink = "kafka-bolt"
+        self.shed_component = "decode-bolt"
+        self.patterns = ("decode_sessions",)
+        self.payloads = {
+            shp: [json.dumps({
+                "session_id": f"{shp}-{i:05d}",
+                "prompt": f"fleet {shp} session {i:05d}",
+                "max_new_tokens": n}).encode()
+                for i in range(self._POOL)]
+            for shp, n in self.TOKENS.items()}
+
+    def _mean_tokens(self) -> float:
+        # matches the trace default shape_mix (0.7, 0.3) over (s1, s8)
+        return 0.7 * self.TOKENS["s1"] + 0.3 * self.TOKENS["s8"]
+
+    def build(self, slo_ms: float):
+        from storm_tpu.config import Config, OffsetsConfig
+        from storm_tpu.connectors import (BrokerSink, BrokerSpout,
+                                          MemoryBroker)
+        from storm_tpu.decode import DecodeBolt, DecodeConfig
+        from storm_tpu.runtime import TopologyBuilder
+        qos = _qos_cfg()
+        cfg = Config()
+        cfg.topology.message_timeout_s = 300.0
+        cfg.tracing.slo_ms = slo_ms
+        cfg.qos = qos
+        cfg.obs = _obs_cfg()
+        broker = MemoryBroker(default_partitions=4)
+        tb = TopologyBuilder()
+        tb.set_spout("kafka-spout",
+                     BrokerSpout(broker, cfg.broker.input_topic,
+                                 OffsetsConfig(policy="earliest",
+                                               max_behind=None),
+                                 fetch_size=1024, scheme="raw", qos=qos),
+                     parallelism=2)
+        # One decode task per cell host: sticky routing needs no ring
+        # here (the ring-grouped multi-task path is exercised in
+        # tests/test_decode.py); what the cell measures is session/token
+        # serving under arrival waves.
+        tb.set_bolt("decode-bolt",
+                    DecodeBolt(DecodeConfig(arena_blocks=64,
+                                            drain_mode="complete"),
+                               qos=qos),
+                    parallelism=1).shuffle_grouping("kafka-spout")
+        tb.set_bolt("kafka-bolt",
+                    BrokerSink(broker, cfg.broker.output_topic, cfg.sink),
+                    parallelism=1).shuffle_grouping("decode-bolt")
+        return broker, cfg, tb.build()
+
+    def probe(self, cluster, slo_ms: float, log: Callable) -> float:
+        """Closed-loop session probe: offer N sessions, wait for ~their
+        token volume to land, return sustained SESSIONS/s (the unit cell
+        rates are declared in)."""
+        broker, run_cfg, topo = self.build(slo_ms)
+        name = "fleet-probe-decode"
+        input_topic = run_cfg.broker.input_topic
+        output_topic = run_cfg.broker.output_topic
+        ref_spec = _trace_spec("decode_sessions", 0, 8.0, 1.0)
+        cluster.submit_topology(name, run_cfg, topo)
+        try:
+            n_warm, n_meas = 32, 192
+            base = broker.topic_size(output_topic)
+            for i in range(n_warm):
+                broker.produce(input_topic,
+                               _mixed_payload(self, ref_spec, i),
+                               key=b"t00000:high")
+            _await_topic(broker, output_topic,
+                         base + int(n_warm * self._mean_tokens() * 0.7),
+                         name)
+            base = broker.topic_size(output_topic)
+            t0 = time.perf_counter()
+            for i in range(n_warm, n_warm + n_meas):
+                broker.produce(input_topic,
+                               _mixed_payload(self, ref_spec, i),
+                               key=b"t00000:high")
+            _await_topic(broker, output_topic,
+                         base + int(n_meas * self._mean_tokens() * 0.7),
+                         name)
+            cap = n_meas / (time.perf_counter() - t0)
+            log(f"[decode] capacity: ~{cap:.0f} sessions/s "
+                f"(~{cap * self._mean_tokens():.0f} tokens/s)")
+            return max(1.0, cap)
+        finally:
+            cluster.kill_topology(name, wait_secs=2)
+            import gc
+            gc.collect()
+
+    def targets(self, pattern: str, slo_ms: float,
+                spec: TraceSpec) -> CellTargets:
+        # Gate on tokens/s goodput (0.4x the offered token rate must
+        # land within the hold) and session TTFT p99 (first token within
+        # 2x the record SLO; TTFT includes prefill's trip through the
+        # continuous queue).
+        return CellTargets(
+            min_tokens_s=round(0.4 * spec.base_rate * self._mean_tokens(),
+                               1),
+            ttft_p99_ms=2.0 * slo_ms,
+            max_shed_frac=0.10)
+
+    def extra_scores(self, rt, snap: dict, scores: dict) -> dict:
+        h = snap.get(self.shed_component, {}).get("decode_ttft_ms")
+        ttft_p99 = (h.get("p99") if isinstance(h, dict) and h.get("count")
+                    else None)
+        hold = scores.get("hold_elapsed_s") or 1.0
+        good = max(0, (scores.get("delivered") or 0)
+                   - (scores.get("slo_breaches") or 0))
+        from storm_tpu.decode import decode_stats
+        d = decode_stats()
+        return {
+            "tokens_per_s": round(good / hold, 1),
+            "ttft_p99_ms": ttft_p99,
+            "sessions_started": sum(r["sessions_started"]
+                                    for r in d["stores"]),
+            "kv_arena": (d["engines"][0]["kv"] if d["engines"] else None),
+        }
+
+
 def _mixed_payload(sc: _Scenario, spec: TraceSpec, i: int) -> bytes:
     """Deterministic golden-ratio interleave of the scenario's payloads
     matching ``spec.shape_mix`` — probe and warm traffic must offer the
@@ -418,6 +578,7 @@ def _make_scenarios(which) -> List[_Scenario]:
                                                 continuous=True),
         "cascade": _CascadeScenario,
         "serve_path": _ServeScenario,
+        "decode": _DecodeScenario,
     }
     return [all_[n]() for n in which]
 
@@ -508,8 +669,8 @@ def run_fleet(args=None, **overrides) -> dict:
                 continue
             sc.setup()
             try:
-                cap1 = _probe_capacity(cluster, sc, slo_ms, log)
-                for pattern in patterns:
+                cap1 = sc.probe(cluster, slo_ms, log)
+                for pattern in (sc.patterns or patterns):
                     cell_seed = seed + 7 * cell_idx
                     cell_idx += 1
                     cell, hygiene, probe = _run_cell(
@@ -604,7 +765,7 @@ def _run_cell(cluster, ui, sc: _Scenario, pattern: str, cell_seed: int,
         # into the measured hold made every first cell start tripped.
         o = Observatory(rt, obs_cfg, sink_components=(sc.sink,)).start()
         s = LoadShedController(
-            rt, ShedPolicy.from_qos(qos_cfg, "inference-bolt",
+            rt, ShedPolicy.from_qos(qos_cfg, sc.shed_component,
                                     sc.sink)).start()
         s.burn = o.burn  # burn is an additional hot signal
         return o, s
@@ -637,7 +798,7 @@ def _run_cell(cluster, ui, sc: _Scenario, pattern: str, cell_seed: int,
     try:
         spec = _trace_spec(pattern, cell_seed, hold_s, cap1)
         trace = generate(spec)
-        targets = _targets_for(pattern, slo_ms)
+        targets = sc.targets(pattern, slo_ms, spec)
 
         # -- warm: compile burst + paced pre-roll, unmeasured --------------
         # Each cell's fresh topology has its OWN engine and jit cache, so
@@ -775,6 +936,7 @@ def _run_cell(cluster, ui, sc: _Scenario, pattern: str, cell_seed: int,
         burn_snap = obs.burn.snapshot()
         good = max(0, delivered - breaches)
         scores = {
+            "hold_elapsed_s": round(hold_elapsed, 2),
             "offered": offered,
             "offered_rate_per_s": round(offered / hold_elapsed, 1),
             "offered_by_lane": lane_offered,
@@ -791,6 +953,7 @@ def _run_cell(cluster, ui, sc: _Scenario, pattern: str, cell_seed: int,
             "burn_tripped": bool(any(r["burn_tripped"] for r in timeline)
                                  or burn_snap.get("trips", 0)),
         }
+        scores.update(sc.extra_scores(rt, s1, scores))
         if verdict_at_peak is None:
             # No leader surfaced during the hold: record the final
             # verdict's compact form (leader may still be null).

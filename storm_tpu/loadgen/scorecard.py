@@ -40,6 +40,10 @@ class CellTargets:
     expect_burn_trip: bool = False
     #: Steady cells: the burn alarm must NOT trip.
     forbid_burn_trip: bool = False
+    #: Decode cells: lower bound on delivered tokens/s goodput.
+    min_tokens_s: Optional[float] = None
+    #: Decode cells: upper bound on session time-to-first-token p99 (ms).
+    ttft_p99_ms: Optional[float] = None
 
 
 def score_cell(scores: Dict[str, object], targets: CellTargets) -> dict:
@@ -48,7 +52,8 @@ def score_cell(scores: Dict[str, object], targets: CellTargets) -> dict:
     Returns ``{"gates": {name: {"ok", "measured", "target"}}, "ok"}``;
     ``ok`` is the AND over the applicable gates. Expected keys in
     ``scores``: ``lane_p99_ms`` (dict), ``goodput_frac``, ``shed_frac``,
-    ``burn_peak``, ``burn_tripped``.
+    ``burn_peak``, ``burn_tripped``; decode cells add ``tokens_per_s``
+    and ``ttft_p99_ms``.
     """
     gates: Dict[str, dict] = {}
 
@@ -82,6 +87,14 @@ def score_cell(scores: Dict[str, object], targets: CellTargets) -> dict:
     if targets.forbid_burn_trip:
         t = bool(scores.get("burn_tripped"))
         gate("burn_not_tripped", not t, t, "False")
+    if targets.min_tokens_s is not None:
+        v = scores.get("tokens_per_s")
+        gate("tokens_per_s", v is not None and v >= targets.min_tokens_s,
+             v, f">= {targets.min_tokens_s}")
+    if targets.ttft_p99_ms is not None:
+        v = scores.get("ttft_p99_ms")
+        gate("ttft_p99_ms", v is not None and v <= targets.ttft_p99_ms,
+             v, f"<= {targets.ttft_p99_ms}")
     return {"gates": gates, "ok": all(g["ok"] for g in gates.values())}
 
 

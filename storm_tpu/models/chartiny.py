@@ -1,0 +1,204 @@
+"""char_tiny: a tiny char-level decoder-only transformer for the decode
+serving tier (round 20).
+
+The decode subsystem (storm_tpu/decode/) needs a *decode-capable*
+checkpoint whose per-token step is cheap enough to run on the CPU test
+mesh yet exercises every piece of real autoregressive serving: a KV
+cache that grows per position, causal attention over the cached prefix,
+ragged per-session lengths, and a logits head to sample from. A 2-layer,
+2-head, d=32 character model is that smallest honest instance — the
+step math is the same shape as a production decoder, only the constants
+are small.
+
+Two deliberate representation choices:
+
+- **Parameters are plain numpy** (seeded, deterministic). The decode
+  step is B<=32 rows of d=32 — at that scale a jit round trip costs more
+  than the matmuls, and numpy keeps the KV arena (a preallocated numpy
+  slab, storm_tpu/decode/kvcache.py) zero-copy adjacent to the compute.
+  The step kernel itself lives in :mod:`storm_tpu.decode.engine`, which
+  owns the arena; this module owns params, tokenization, and the pure
+  per-layer building blocks, so the engine's incremental step and any
+  full-context reference forward share one definition of the math.
+- **The registry entry is the stateless single-token classify view** of
+  the same weights: ``apply(params, state, x)`` scores one token with no
+  prefix — next-char prediction as a classify workload. That is what
+  lets classify traffic co-batch with decode steps on the SAME engine
+  queue (the stateless rows ride the decode engine's continuous batcher
+  as ``slot=-1`` rows); registering it keeps char_tiny a first-class
+  ``ModelConfig.name`` for that traffic.
+
+Vocabulary: 0 = BOS, 1 = EOS, 2..97 = printable ASCII 32..127.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from storm_tpu.models import registry
+
+VOCAB = 98
+BOS, EOS = 0, 1
+D_MODEL = 32
+N_HEADS = 2
+N_LAYERS = 2
+D_FF = 64
+MAX_SEQ = 192  # positional table length; arenas may cap lower
+
+_CHAR0 = 32  # token 2 is chr(32)
+
+
+def encode_text(text: str) -> List[int]:
+    """Chars -> token ids (BOS prepended by callers that want it).
+    Out-of-range chars clamp to '?'."""
+    out = []
+    for ch in text:
+        o = ord(ch)
+        if not _CHAR0 <= o < _CHAR0 + (VOCAB - 2):
+            o = ord("?")
+        out.append(o - _CHAR0 + 2)
+    return out
+
+
+def decode_tokens(tokens) -> str:
+    """Token ids -> chars; BOS/EOS render as ''."""
+    return "".join(
+        chr(int(t) - 2 + _CHAR0) for t in tokens
+        if int(t) not in (BOS, EOS) and 2 <= int(t) < VOCAB)
+
+
+def build_params(seed: int = 0) -> Dict[str, np.ndarray]:
+    """Deterministic float32 param dict. Same seed -> byte-identical
+    params (the decode replay/migration tests depend on it)."""
+    rng = np.random.default_rng(int(seed))
+
+    def w(*shape, scale=0.08):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    params: Dict[str, np.ndarray] = {
+        "embed": w(VOCAB, D_MODEL),
+        "pos": w(MAX_SEQ, D_MODEL, scale=0.02),
+        "lnf_g": np.ones(D_MODEL, np.float32),
+        "lnf_b": np.zeros(D_MODEL, np.float32),
+    }
+    for layer in range(N_LAYERS):
+        p = f"l{layer}_"
+        params[p + "ln1_g"] = np.ones(D_MODEL, np.float32)
+        params[p + "ln1_b"] = np.zeros(D_MODEL, np.float32)
+        params[p + "wq"] = w(D_MODEL, D_MODEL)
+        params[p + "wk"] = w(D_MODEL, D_MODEL)
+        params[p + "wv"] = w(D_MODEL, D_MODEL)
+        params[p + "wo"] = w(D_MODEL, D_MODEL)
+        params[p + "ln2_g"] = np.ones(D_MODEL, np.float32)
+        params[p + "ln2_b"] = np.zeros(D_MODEL, np.float32)
+        params[p + "w1"] = w(D_MODEL, D_FF)
+        params[p + "b1"] = np.zeros(D_FF, np.float32)
+        params[p + "w2"] = w(D_FF, D_MODEL)
+        params[p + "b2"] = np.zeros(D_MODEL, np.float32)
+    return params
+
+
+# ---- pure per-layer pieces (shared by the engine's step kernel) --------------
+
+
+def layer_norm(x: np.ndarray, g: np.ndarray, b: np.ndarray) -> np.ndarray:
+    mu = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + 1e-5) * g + b
+
+
+def qkv(params: dict, layer: int, x: np.ndarray):
+    """Pre-norm projections for one layer: x (B, D) -> q, k, v (B, D)."""
+    p = f"l{layer}_"
+    a = layer_norm(x, params[p + "ln1_g"], params[p + "ln1_b"])
+    return a @ params[p + "wq"], a @ params[p + "wk"], a @ params[p + "wv"]
+
+
+def attn_out(params: dict, layer: int, x: np.ndarray, q: np.ndarray,
+             keys: np.ndarray, vals: np.ndarray,
+             mask: np.ndarray) -> np.ndarray:
+    """Masked multi-head attention + residual for one layer.
+
+    ``q`` (B, D); ``keys``/``vals`` (B, T, D) — each row's cached prefix,
+    gathered by the caller; ``mask`` (B, T) True where position j is
+    attendable for row i (j <= pos_i). Returns the post-attention hidden
+    (residual added), B x D.
+    """
+    b, t, _ = keys.shape
+    hd = D_MODEL // N_HEADS
+    qh = q.reshape(b, N_HEADS, hd)
+    kh = keys.reshape(b, t, N_HEADS, hd)
+    vh = vals.reshape(b, t, N_HEADS, hd)
+    # scores: (B, H, T)
+    scores = np.einsum("bhd,bthd->bht", qh, kh) / np.sqrt(hd)
+    scores = np.where(mask[:, None, :], scores, -1e30)
+    scores = scores - scores.max(axis=-1, keepdims=True)
+    w = np.exp(scores)
+    w = w / w.sum(axis=-1, keepdims=True)
+    out = np.einsum("bht,bthd->bhd", w, vh).reshape(b, D_MODEL)
+    p = f"l{layer}_"
+    return x + out @ params[p + "wo"]
+
+
+def mlp_out(params: dict, layer: int, x: np.ndarray) -> np.ndarray:
+    p = f"l{layer}_"
+    a = layer_norm(x, params[p + "ln2_g"], params[p + "ln2_b"])
+    h = np.maximum(a @ params[p + "w1"] + params[p + "b1"], 0.0)
+    return x + h @ params[p + "w2"] + params[p + "b2"]
+
+
+def logits_head(params: dict, x: np.ndarray) -> np.ndarray:
+    """Final norm + tied-embedding head: (B, D) -> (B, VOCAB)."""
+    a = layer_norm(x, params["lnf_g"], params["lnf_b"])
+    return a @ params["embed"].T
+
+
+def stateless_logits(params: dict, tokens: np.ndarray) -> np.ndarray:
+    """Next-char logits for single tokens with NO prefix (each row
+    attends only to itself at position 0) — the classify view the
+    registry exposes, and the ``slot=-1`` row semantics of the decode
+    engine."""
+    tokens = np.asarray(tokens, np.int64).reshape(-1)
+    x = params["embed"][tokens] + params["pos"][0]
+    b = x.shape[0]
+    mask = np.ones((b, 1), bool)
+    for layer in range(N_LAYERS):
+        q, k, v = qkv(params, layer, x)
+        x = attn_out(params, layer, x, q, k[:, None, :], v[:, None, :],
+                     mask)
+        x = mlp_out(params, layer, x)
+    return logits_head(params, x)
+
+
+@registry.register("char_tiny")
+def char_tiny(num_classes: int = VOCAB, input_shape=(1,),
+              **_ignored) -> registry.ModelDef:
+    """Registry entry: the stateless next-char classify view.
+
+    ``x`` is (B, 1) token ids (any int/float dtype; floats are trunc-
+    cast); logits are (B, VOCAB). Params come from :func:`build_params`
+    keyed on the PRNGKey's fold-in seed so the registry path and the
+    decode engine share weights for the same ``ModelConfig.seed``.
+    """
+
+    def init(rng):
+        # PRNGKey(seed) stores the seed in its last word — reuse it so
+        # init_params(model, seed) == build_params(seed).
+        seed = int(np.asarray(rng)[-1])
+        return build_params(seed), {}
+
+    def apply(params, state, x, train=False):
+        tokens = np.asarray(x).reshape(len(x), -1)[:, 0]
+        return stateless_logits(params, tokens), state
+
+    return registry.ModelDef(
+        name="char_tiny",
+        input_shape=tuple(input_shape),
+        num_classes=int(num_classes),
+        init=init,
+        apply=apply,
+        hyper={"d_model": D_MODEL, "n_heads": N_HEADS,
+               "n_layers": N_LAYERS, "vocab": VOCAB},
+    )

@@ -59,6 +59,7 @@ def register(name: str) -> Callable:
 def _load_builtin() -> None:
     # Import model modules lazily so registration happens on demand.
     from storm_tpu.models import (  # noqa: F401
+        chartiny,
         lenet,
         longseq,
         mixer,

@@ -287,7 +287,22 @@ class Observatory:
             "copies": self.copies_snapshot(),
             "corrector": (self.corrector.snapshot()
                           if self.corrector is not None else None),
+            "decode": self.decode_snapshot(),
         }
+
+    def decode_snapshot(self) -> dict:
+        """Decode-tier rows (sessions + KV arenas) when the decode
+        package is live in this process; empty-shaped otherwise. Lazy
+        import: the observatory must not pull the decode tier (and its
+        model deps) into processes that never decode."""
+        import sys
+
+        if "storm_tpu.decode" not in sys.modules:
+            return {"stores": [], "engines": [], "sessions_live": 0,
+                    "tokens_emitted": 0}
+        from storm_tpu.decode import decode_stats
+
+        return decode_stats()
 
     def copies_snapshot(self) -> dict:
         """The copy tree both ways: cumulative totals (the CLI table)

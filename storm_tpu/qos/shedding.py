@@ -36,6 +36,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from storm_tpu.config import QosConfig
+from storm_tpu.runtime.frames import RecordFrame
 
 log = logging.getLogger("storm_tpu.qos")
 
@@ -117,7 +118,8 @@ class LoadShedController:
         p = self.policy
         execs = self.rt.bolt_execs.get(p.component, [])
         inbox_frac = max(
-            (e.inbox.qsize() / max(1, e.inbox.maxsize) for e in execs),
+            (self._inbox_rows(e.inbox) / max(1, e.inbox.maxsize)
+             for e in execs),
             default=0.0)
         wait = self.rt.metrics.histogram(p.component, "batch_wait_ms")
         wait_p95 = wait.percentile(95) if wait.count else 0.0
@@ -136,6 +138,24 @@ class LoadShedController:
             "burn_rate": burn.fast_burn if burn is not None else 0.0,
             "burn_tripped": burn.tripped if burn is not None else False,
         }
+
+    @staticmethod
+    def _inbox_rows(inbox) -> int:
+        """Queued RECORDS, not queued tuples. Batch-native ingress parks
+        RecordFrames on the inbox — one tuple carrying hundreds of rows —
+        so qsize() under-reads pressure by the frame fan-in factor and
+        de-sensitizes every inbox-driven shed trigger (r19 OPERATIONS
+        note, fixed round 20). Reads the asyncio.Queue's internal deque:
+        a point-in-time sweep on the event-loop thread, no lock needed."""
+        rows = 0
+        for item in getattr(inbox, "_queue", ()):
+            payload = (item.values[0]
+                       if getattr(item, "values", None) else None)
+            if isinstance(payload, (RecordFrame, list, tuple)):
+                rows += len(payload)
+            else:
+                rows += 1
+        return rows
 
     def step(self) -> Optional[int]:
         """One evaluation (synchronous — all signals are in-process reads);

@@ -439,3 +439,49 @@ def test_scorecard_artifact_gates():
 
     assert art["capture_session"].startswith("cap-")
     assert art["code_version"]
+
+
+def test_decode_artifact_gates():
+    """BENCH_DECODE_r20.json backs the round-20 stateful decode docs:
+    a positive tokens/s headline with TTFT + per-token percentiles, the
+    injected-failure exactly-once audit clean (gapless, duplicate-free,
+    all requests acked), the rolling-restart probe with >= 95% of live
+    sessions KV-restored and ZERO cold starts, and the decode tier
+    visible as rows in the occupancy/profile observatories."""
+    import json
+
+    art = json.loads((REPO / "BENCH_DECODE_r20.json").read_text())
+    assert art["metric"] == "decode_tokens_per_s_r20"
+    for gate, ok in art["gates"].items():
+        assert ok is True, f"gate {gate} failed at capture time"
+    assert art["value"] > 0
+    assert art["tokens_per_s_samples"] == sorted(
+        art["tokens_per_s_samples"])
+    assert len(art["cells"]) >= 2  # interleaving protocol: repeats
+    for c in art["cells"]:
+        assert c["tokens"] > 0 and c["sessions"] > 0
+        assert 0 < c["ttft_p50_ms"] <= c["ttft_p99_ms"]
+        assert 0 < c["token_p50_ms"] <= c["token_p99_ms"]
+        assert c["audit"]["clean"] is True
+
+    au = art["exactly_once_audit"]
+    assert au["injected_failures"] >= 1 and au["request_replays"] >= 1
+    assert au["duplicates"] == 0 and au["gapped_sessions"] == 0
+    assert au["clean"] is True and au["all_acked"] is True
+
+    probe = art["migration_probe"]
+    assert probe["live_at_kill"] > 0
+    assert probe["survived_frac"] >= 0.95
+    assert probe["cold_started"] == 0
+    assert probe["kv_restored"] >= probe["live_at_kill"] * 0.95
+    assert probe["all_acked_after_restart"] is True
+    assert probe["audit_across_restart"]["clean"] is True
+
+    # decode sessions are first-class observatory rows
+    obs = art["cells"][-1]["observatory"]
+    assert obs["engine_rows"] and obs["occupancy"]
+    assert any("decode" in k for k in obs["profile_keys"])
+    assert obs["decode"]["tokens_emitted"] > 0
+
+    assert art["capture_session"].startswith("cap-")
+    assert art["code_version"]

@@ -276,14 +276,18 @@ def test_shed_controller_middling_signals_reset_both_streaks():
 
 
 def test_shed_controller_inbox_signal():
+    # The signal counts queued RECORDS (round 20): a parked frame tuple
+    # contributes its row count, a plain tuple contributes 1.
     reg = MetricsRegistry()
+    frame = SimpleNamespace(values=[list(range(45))])
+    queued = [frame] + [SimpleNamespace(values=["rec"]) for _ in range(45)]
     full = SimpleNamespace(
-        inbox=SimpleNamespace(qsize=lambda: 90, maxsize=100))
+        inbox=SimpleNamespace(_queue=queued, maxsize=100))
     rt = SimpleNamespace(metrics=reg,
                          bolt_execs={"inference-bolt": [full]}, flight=None)
     ctl = LoadShedController(rt, ShedPolicy(hot_steps=2, calm_steps=2))
     assert ctl.step() is None
-    assert ctl.step() == 1  # inbox 90% > 50% threshold, two hot steps
+    assert ctl.step() == 1  # 45-row frame + 45 tuples = 90% > 50%, two hot steps
 
 
 def test_shed_policy_from_qos():
